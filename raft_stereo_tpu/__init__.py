@@ -1,0 +1,27 @@
+"""raft_stereo_tpu — a TPU-native (JAX/XLA/Pallas) stereo-depth framework.
+
+A from-scratch re-design of the capabilities of RAFT-Stereo (Lipson, Teed &
+Deng, 3DV 2021; reference implementation studied at /root/reference): iterative
+multi-level ConvGRU disparity refinement over a pluggable 1-D correlation layer,
+with NHWC layout, functional params, ``lax.scan`` refinement, shard_map/pjit
+parallelism and Pallas kernels on the hot path.
+"""
+
+from raft_stereo_tpu.config import (
+    RAFTStereoConfig,
+    TrainConfig,
+    realtime_config,
+    rvc_config,
+    sceneflow_config,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "RAFTStereoConfig",
+    "TrainConfig",
+    "sceneflow_config",
+    "realtime_config",
+    "rvc_config",
+    "__version__",
+]

@@ -1,0 +1,119 @@
+"""Single dataclass config for the whole framework.
+
+The reference threads a raw ``argparse.Namespace`` into every layer (model ctor
+``core/raft_stereo.py:23-25``, update block ``core/update.py:98-101``, data loader
+``core/stereo_datasets.py:283-292``) and re-declares the flag surface in each entry
+script (``train_stereo.py:214-249``, ``evaluate_stereo.py:192-209``, ``demo.py:55-75``).
+Here a frozen dataclass is defined once and shared by model, training, eval and demo;
+the public flag names are preserved because they are the reference's CLI API.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+# The plugin switch preserved from the reference (--corr_implementation,
+# core/raft_stereo.py:90-100). "reg_pallas"/"alt_pallas" replace the CUDA
+# extensions ("reg_cuda"/"alt_cuda") with TPU Pallas kernels.
+CORR_IMPLEMENTATIONS = ("reg", "alt", "reg_pallas", "alt_pallas")
+# Aliases so reference command lines keep working.
+CORR_ALIASES = {"reg_cuda": "reg_pallas", "alt_cuda": "alt_pallas"}
+
+NORM_FNS = ("group", "batch", "instance", "none")
+
+
+@dataclasses.dataclass(frozen=True)
+class RAFTStereoConfig:
+    """Architecture config (the reference's "Architecture choices" flag group)."""
+
+    # Hidden state and context dims, ordered coarse->fine: hidden_dims[0] is the
+    # 1/32-resolution GRU, hidden_dims[2] the 1/8-resolution GRU
+    # (core/extractor.py:227-250, core/update.py:104-106).
+    hidden_dims: Tuple[int, ...] = (128, 128, 128)
+    corr_implementation: str = "reg"
+    shared_backbone: bool = False
+    corr_levels: int = 4
+    corr_radius: int = 4
+    n_downsample: int = 2
+    context_norm: str = "batch"
+    slow_fast_gru: bool = False
+    n_gru_layers: int = 3
+    mixed_precision: bool = False
+
+    def __post_init__(self):
+        impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
+        object.__setattr__(self, "corr_implementation", impl)
+        object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
+        if impl not in CORR_IMPLEMENTATIONS:
+            raise ValueError(f"unknown corr_implementation {impl!r}")
+        if self.context_norm not in NORM_FNS:
+            raise ValueError(f"unknown context_norm {self.context_norm!r}")
+        if not 1 <= self.n_gru_layers <= 3:
+            raise ValueError("n_gru_layers must be in {1,2,3}")
+
+    @property
+    def factor(self) -> int:
+        """Resolution factor of the disparity field (2**n_downsample)."""
+        return 2 ** self.n_downsample
+
+    @property
+    def corr_channels(self) -> int:
+        """Channels produced by a correlation lookup (core/update.py:69)."""
+        return self.corr_levels * (2 * self.corr_radius + 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Training loop config (reference "Training parameters", train_stereo.py:220-231)."""
+
+    name: str = "raft-stereo"
+    restore_ckpt: Optional[str] = None
+    batch_size: int = 6
+    train_datasets: Tuple[str, ...] = ("sceneflow",)
+    lr: float = 0.0002
+    num_steps: int = 100000
+    image_size: Tuple[int, int] = (320, 720)
+    train_iters: int = 16
+    valid_iters: int = 32
+    wdecay: float = 1e-5
+    # Data augmentation (train_stereo.py:244-248)
+    img_gamma: Optional[Tuple[float, ...]] = None
+    saturation_range: Optional[Tuple[float, float]] = None
+    do_flip: Optional[str] = None  # False/'h'/'v'
+    spatial_scale: Tuple[float, float] = (0.0, 0.0)
+    noyjitter: bool = False
+    # Ours: data root, seed, checkpoint dir, validation cadence, device mesh.
+    data_root: str = "datasets"
+    seed: int = 1234
+    ckpt_dir: str = "checkpoints"
+    validation_frequency: int = 10000
+    num_workers: int = 4
+    # Parallelism: number of data-parallel and sequence(width)-parallel shards.
+    # data_parallel <= 0 means "use all available devices".
+    data_parallel: int = 0
+    seq_parallel: int = 1
+
+
+# --- Named presets mirroring the reference's published training commands -------------
+
+def sceneflow_config() -> tuple[RAFTStereoConfig, TrainConfig]:
+    """README.md:130 SceneFlow recipe: batch 8, 22 train iters, 200k steps, bf16."""
+    return (
+        RAFTStereoConfig(mixed_precision=True),
+        TrainConfig(batch_size=8, train_iters=22, num_steps=200000,
+                    spatial_scale=(-0.2, 0.4)),
+    )
+
+
+def realtime_config() -> RAFTStereoConfig:
+    """README.md:105 fastest configuration (7 valid iters at 1/8 resolution)."""
+    return RAFTStereoConfig(
+        shared_backbone=True, n_downsample=3, n_gru_layers=2, slow_fast_gru=True,
+        corr_implementation="reg_pallas", mixed_precision=True,
+    )
+
+
+def rvc_config() -> RAFTStereoConfig:
+    """README.md:81 iRaftStereo_RVC: instance-normalized context encoder."""
+    return RAFTStereoConfig(context_norm="instance")

@@ -1,0 +1,24 @@
+from raft_stereo_tpu.ops.corr import (
+    CorrState,
+    all_pairs_correlation,
+    corr_lookup,
+    init_corr,
+    register_corr,
+)
+from raft_stereo_tpu.ops.geometry import (
+    pool_last_axis2,
+    InputPadder,
+    avg_pool2d,
+    coords_grid,
+    extract_3x3_patches,
+    pool2x,
+    pool_w2,
+    resize_bilinear_align_corners,
+    upflow,
+    upsample_flow_convex,
+)
+from raft_stereo_tpu.ops.sampler import (
+    gather_window_2d,
+    linear_sample_1d,
+    window_taps,
+)

@@ -1,0 +1,150 @@
+"""Pluggable 1-D correlation layer — the hot path.
+
+The reference selects between four interchangeable correlation implementations
+with the ``--corr_implementation`` string (core/raft_stereo.py:90-100):
+
+* ``reg``   — materialize the all-pairs volume once, pyramid-pool it, and do a
+  (2r+1)-tap linear lookup per level per iteration (core/corr.py:110-156).
+* ``alt``   — never materialize the O(H*W^2) volume; per iteration, sample the
+  pooled right feature map at the lookup taps and dot with the left features
+  (core/corr.py:64-107). O(W) memory, for high-resolution images.
+* ``reg_cuda``/``alt_cuda`` — CUDA-fused variants (sampler/sampler_kernel.cu).
+
+This module keeps the same plugin surface, TPU-first: the volume is built with a
+batched row matmul (MXU), lookups are contiguous-window gathers, and the fused
+variants (``reg_pallas``/``alt_pallas``) are Pallas kernels registered here.
+
+Because the refinement loop is a ``lax.scan``, the correlation state must be a
+pytree: ``init_corr`` returns a :class:`CorrState` carrying either the pooled
+volume pyramid (reg) or the feature-map pyramid (alt); ``corr_lookup`` is a pure
+function of ``(state, coords)``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from raft_stereo_tpu.ops.geometry import pool_last_axis2, pool_w2
+from raft_stereo_tpu.ops.sampler import gather_window_2d, linear_sample_1d, window_taps
+
+
+@struct.dataclass
+class CorrState:
+    """Pytree correlation state threaded through the refinement scan."""
+
+    levels: Tuple[jax.Array, ...]  # per-level volume (reg) or fmap2 (alt)
+    fmap1: jax.Array | None        # left features, only for alt-style lookups
+    impl: str = struct.field(pytree_node=False)
+    radius: int = struct.field(pytree_node=False)
+
+
+def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
+    """All-pairs 1-D correlation volume ``(B, H, W1, W2)``, scaled by 1/sqrt(D).
+
+    The reference's ``einsum('aijk,aijh->ajkh')`` (core/corr.py:148-156), NHWC:
+    per (batch, row) this is a (W1, D) x (D, W2) matmul — large, batched, and
+    MXU-shaped. Accumulates in fp32 regardless of input dtype.
+    """
+    d = fmap1.shape[-1]
+    corr = jnp.einsum("bhwd,bhvd->bhwv", fmap1, fmap2,
+                      preferred_element_type=jnp.float32)
+    return corr / jnp.sqrt(jnp.float32(d))
+
+
+def _build_reg(fmap1, fmap2, num_levels, radius) -> CorrState:
+    volume = all_pairs_correlation(fmap1.astype(jnp.float32),
+                                   fmap2.astype(jnp.float32))
+    levels = [volume]
+    for _ in range(num_levels - 1):
+        levels.append(pool_last_axis2(levels[-1]))
+    return CorrState(levels=tuple(levels), fmap1=None, impl="reg", radius=radius)
+
+
+def _build_alt(fmap1, fmap2, num_levels, radius) -> CorrState:
+    fmap1 = fmap1.astype(jnp.float32)
+    fmap2 = fmap2.astype(jnp.float32)
+    levels = [fmap2]
+    for _ in range(num_levels - 1):
+        levels.append(pool_w2(levels[-1]))
+    return CorrState(levels=tuple(levels), fmap1=fmap1, impl="alt", radius=radius)
+
+
+def _lookup_reg(state: CorrState, coords_x: jax.Array) -> jax.Array:
+    """(2r+1)-tap pyramid lookup on the materialized volume.
+
+    ``coords_x``: (B, H, W1) lookup centers in level-0 pixel units. Output
+    channel order is [level0 taps -r..r, level1 taps, ...] (core/corr.py:127-146).
+    """
+    out = []
+    for i, volume in enumerate(state.levels):
+        taps = window_taps(coords_x / (2 ** i), state.radius)  # (B,H,W1,2r+1)
+        out.append(linear_sample_1d(volume, taps))
+    return jnp.concatenate(out, axis=-1)
+
+
+def _lookup_alt(state: CorrState, coords_x: jax.Array) -> jax.Array:
+    """On-the-fly lookup: sample fmap2 windows, dot with fmap1 (core/corr.py:72-107)."""
+    d = state.fmap1.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    out = []
+    for i, fmap2 in enumerate(state.levels):
+        taps = window_taps(coords_x / (2 ** i), state.radius)  # (B,H,W1,K)
+        f2 = gather_window_2d(fmap2, taps)                     # (B,H,W1,K,D)
+        corr = jnp.einsum("bhwkd,bhwd->bhwk", f2, state.fmap1,
+                          preferred_element_type=jnp.float32)
+        out.append(corr * scale)
+    return jnp.concatenate(out, axis=-1)
+
+
+_BUILDERS: Dict[str, Callable] = {}
+_LOOKUPS: Dict[str, Callable] = {}
+
+
+def register_corr(name: str, builder: Callable, lookup: Callable) -> None:
+    """Register a correlation implementation (the plugin registry).
+
+    ``builder(fmap1, fmap2, num_levels, radius) -> CorrState`` and
+    ``lookup(state, coords_x) -> (B, H, W1, num_levels*(2r+1))`` features.
+    New strategies (e.g. a ring-sharded variant for very wide images) plug in
+    here without touching the model.
+    """
+    _BUILDERS[name] = builder
+    _LOOKUPS[name] = lookup
+
+
+register_corr("reg", _build_reg, _lookup_reg)
+register_corr("alt", _build_alt, _lookup_alt)
+
+
+def init_corr(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,
+              num_levels: int = 4, radius: int = 4) -> CorrState:
+    """Build correlation state from NHWC feature maps ``(B, H, W, D)``."""
+    if impl not in _BUILDERS:
+        _maybe_register_pallas()
+    if impl not in _BUILDERS:
+        raise ValueError(f"unknown corr implementation {impl!r}; "
+                         f"registered: {sorted(_BUILDERS)}")
+    return _BUILDERS[impl](fmap1, fmap2, num_levels, radius)
+
+
+def corr_lookup(state: CorrState, coords: jax.Array) -> jax.Array:
+    """Look up correlation features at ``coords`` ``(B, H, W, 2)`` (x, y channels).
+
+    Only the x channel is used — disparity search is along the epipolar line
+    (core/corr.py:129 ``coords[:, :1]``). Returns fp32 features
+    ``(B, H, W, num_levels*(2r+1))``.
+    """
+    coords_x = coords[..., 0].astype(jnp.float32)
+    return _LOOKUPS[state.impl](state, coords_x)
+
+
+def _maybe_register_pallas() -> None:
+    """Lazily register the Pallas-fused implementations (import cycle guard)."""
+    try:
+        from raft_stereo_tpu.ops.pallas import corr_kernels  # noqa: F401
+    except ImportError:
+        pass

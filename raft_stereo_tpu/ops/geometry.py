@@ -1,0 +1,181 @@
+"""Geometry / resampling ops (NHWC, TPU-first).
+
+TPU-native re-design of the reference's tensor utilities (core/utils/utils.py)
+and the convex-upsampling path (core/raft_stereo.py:55-67): everything is NHWC
+(channel-last, the TPU-preferred layout), align-corners bilinear resizes are
+expressed as two small dense interpolation matmuls (MXU-friendly, no gathers),
+and convex upsampling is 9 static shifts + an einsum instead of ``F.unfold``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def coords_grid(batch: int, ht: int, wd: int, dtype=jnp.float32) -> jax.Array:
+    """Pixel coordinate grid ``(B, H, W, 2)`` with channels ``(x, y)``.
+
+    Mirrors ``coords_grid`` (core/utils/utils.py:77-80), channel-last.
+    """
+    ys, xs = jnp.meshgrid(jnp.arange(ht, dtype=dtype), jnp.arange(wd, dtype=dtype),
+                          indexing="ij")
+    grid = jnp.stack([xs, ys], axis=-1)
+    return jnp.broadcast_to(grid[None], (batch, ht, wd, 2))
+
+
+def avg_pool2d(x: jax.Array, window: Tuple[int, int], stride: Tuple[int, int],
+               padding: Tuple[int, int] = (0, 0)) -> jax.Array:
+    """NHWC average pool matching ``F.avg_pool2d(count_include_pad=True)``.
+
+    Padded zeros count toward the divisor (the torch default), so the sum is
+    always divided by ``window[0]*window[1]``. Windows that would overhang the
+    input with no padding are dropped (floor semantics), as in torch.
+    """
+    kh, kw = window
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add,
+        window_dimensions=(1, kh, kw, 1),
+        window_strides=(1, stride[0], stride[1], 1),
+        padding=((0, 0), (padding[0], padding[0]), (padding[1], padding[1]), (0, 0)),
+    )
+    return summed / (kh * kw)
+
+
+def pool2x(x: jax.Array) -> jax.Array:
+    """3x3 stride-2 pad-1 average pool (core/update.py:87-88)."""
+    return avg_pool2d(x, (3, 3), (2, 2), (1, 1))
+
+
+def pool_w2(x: jax.Array) -> jax.Array:
+    """[1,2] stride [1,2] average pool along W (corr pyramid, core/corr.py:124)."""
+    return avg_pool2d(x, (1, 2), (1, 2), (0, 0))
+
+
+def pool_last_axis2(x: jax.Array) -> jax.Array:
+    """Stride-2 window-2 average pool along the LAST axis (floor semantics).
+
+    Used on the ``(B, H, W1, W2)`` correlation volume, whose disparity-search
+    axis W2 is the trailing axis (the reference reshapes to ``(B*H*W1,1,1,W2)``
+    and pools ``[1,2]`` — core/corr.py:120-124).
+    """
+    ndim = x.ndim
+    window = (1,) * (ndim - 1) + (2,)
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add,
+        window_dimensions=window, window_strides=window,
+        padding=((0, 0),) * ndim,
+    )
+    return summed / 2.0
+
+
+def _interp_matrix(n_out: int, n_in: int, dtype=jnp.float32) -> jax.Array:
+    """Dense ``(n_out, n_in)`` align-corners linear interpolation matrix.
+
+    Sample positions are ``linspace(0, n_in-1, n_out)`` — the align_corners=True
+    grid of ``F.interpolate(mode='bilinear')``. Built with numpy at trace time
+    (shapes are static under jit) so the resize becomes a single matmul.
+    """
+    if n_in == 1:
+        return np.ones((n_out, 1), dtype=np.float32)
+    pos = np.linspace(0.0, n_in - 1.0, n_out)
+    i0 = np.floor(pos).astype(np.int64)
+    i0 = np.clip(i0, 0, n_in - 2)
+    frac = pos - i0
+    m = np.zeros((n_out, n_in), dtype=np.float32)
+    rows = np.arange(n_out)
+    m[rows, i0] = 1.0 - frac
+    m[rows, i0 + 1] = frac
+    return jnp.asarray(m, dtype=dtype)
+
+
+def resize_bilinear_align_corners(x: jax.Array, size: Tuple[int, int]) -> jax.Array:
+    """NHWC bilinear resize with align_corners=True semantics.
+
+    Mirrors ``interp`` (core/update.py:93-95) and the value-grid of ``upflow8``
+    (core/utils/utils.py:83-85). Expressed as two dense interpolation matmuls
+    (separable), which XLA maps onto the MXU instead of emitting gathers.
+    """
+    h_out, w_out = size
+    b, h_in, w_in, c = x.shape
+    if (h_in, w_in) == (h_out, w_out):
+        return x
+    mh = _interp_matrix(h_out, h_in, x.dtype)
+    mw = _interp_matrix(w_out, w_in, x.dtype)
+    x = jnp.einsum("oh,bhwc->bowc", mh, x)
+    x = jnp.einsum("ow,bhwc->bhoc", mw, x)
+    return x
+
+
+def upflow(flow: jax.Array, factor: int = 8) -> jax.Array:
+    """Upsample a flow field by ``factor`` and scale its values (utils.py:83-85)."""
+    b, h, w, c = flow.shape
+    return factor * resize_bilinear_align_corners(flow, (factor * h, factor * w))
+
+
+def extract_3x3_patches(x: jax.Array) -> jax.Array:
+    """Zero-padded 3x3 patch extraction: ``(B,H,W,C) -> (B,H,W,9,C)``.
+
+    Patch index k = 3*dy + dx (row-major over the 3x3 window), matching the
+    channel order of ``F.unfold(..., [3,3], padding=1)`` used by convex
+    upsampling (core/raft_stereo.py:62).
+    """
+    padded = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    h, w = x.shape[1], x.shape[2]
+    shifts = [padded[:, dy:dy + h, dx:dx + w, :] for dy in range(3) for dx in range(3)]
+    return jnp.stack(shifts, axis=3)
+
+
+def upsample_flow_convex(flow: jax.Array, mask: jax.Array, factor: int) -> jax.Array:
+    """Convex-combination upsampling of flow (core/raft_stereo.py:55-67).
+
+    Args:
+      flow: ``(B, H, W, C)`` low-resolution flow (C=2).
+      mask: ``(B, H, W, 9*factor*factor)`` unnormalized weights from the mask
+        head; channel index decomposes as ``k*factor*factor + fy*factor + fx``
+        (the reference's ``view(N, 1, 9, factor, factor, H, W)``).
+      factor: upsampling factor (2**n_downsample).
+
+    Returns:
+      ``(B, factor*H, factor*W, C)`` upsampled flow; values scaled by ``factor``.
+    """
+    b, h, w, c = flow.shape
+    mask = mask.reshape(b, h, w, 9, factor, factor)
+    mask = jax.nn.softmax(mask, axis=3)
+    patches = extract_3x3_patches(factor * flow)  # (B,H,W,9,C)
+    up = jnp.einsum("bhwkyx,bhwkc->bhwyxc", mask, patches)
+    # (B,H,W,fy,fx,C) -> (B, H*fy, W*fx, C)
+    up = up.transpose(0, 1, 3, 2, 4, 5)
+    return up.reshape(b, h * factor, w * factor, c)
+
+
+class InputPadder:
+    """Pads NHWC images so H, W are divisible by ``divis_by`` (utils.py:7-26).
+
+    ``mode='sintel'`` splits padding evenly top/bottom; otherwise all height
+    padding goes to the bottom. Replicate padding, exact unpad.
+    """
+
+    def __init__(self, dims: Sequence[int], mode: str = "sintel", divis_by: int = 8):
+        self.ht, self.wd = dims[-3], dims[-2]  # NHWC
+        pad_ht = (((self.ht // divis_by) + 1) * divis_by - self.ht) % divis_by
+        pad_wd = (((self.wd // divis_by) + 1) * divis_by - self.wd) % divis_by
+        if mode == "sintel":
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
+                         pad_ht // 2, pad_ht - pad_ht // 2]
+        else:
+            self._pad = [pad_wd // 2, pad_wd - pad_wd // 2, 0, pad_ht]
+
+    def pad(self, *inputs: jax.Array):
+        l, r, t, b = self._pad
+        out = [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)), mode="edge")
+               for x in inputs]
+        return out if len(out) > 1 else out[0]
+
+    def unpad(self, x: jax.Array) -> jax.Array:
+        l, r, t, b = self._pad
+        ht, wd = x.shape[-3], x.shape[-2]
+        return x[..., t:ht - b, l:wd - r, :]

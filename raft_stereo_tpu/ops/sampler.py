@@ -1,0 +1,95 @@
+"""1-D linear sampling primitives.
+
+The reference's correlation lookup is, in every implementation, a 1-D linear
+interpolation along the disparity axis:
+
+* ``bilinear_sampler`` (core/utils/utils.py:59-74) wraps ``grid_sample`` with
+  ``align_corners=True`` and zero padding; on the ``(B*H*W1, 1, 1, W2)``-shaped
+  correlation volume the ``H > 1`` guard makes it exactly 1-D.
+* the CUDA kernel (sampler/sampler_kernel.cu:20-60) computes ``dy`` but never
+  uses it — it blends two adjacent taps along W2 with weights ``1-dx``/``dx``.
+
+Here that semantics is one pure function on the *last* axis, expressed as a
+clip-gather + mask (dynamic-slice-friendly for XLA) rather than a random-access
+scatter/gather. Out-of-range coordinates contribute zero, matching
+``grid_sample(padding_mode='zeros', align_corners=True)`` exactly: a tap at
+coordinate ``x`` blends ``v[floor(x)]`` and ``v[floor(x)+1]``, where any index
+outside ``[0, W-1]`` reads as 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linear_sample_1d(values: jax.Array, x: jax.Array) -> jax.Array:
+    """Linearly sample ``values`` along its last axis at pixel coordinates ``x``.
+
+    Args:
+      values: ``(..., W)`` array. Leading dims must broadcast with ``x``'s leading
+        dims (all but the last axis of ``x``).
+      x: ``(..., K)`` pixel coordinates in ``[0, W-1]`` (out-of-range gives 0).
+
+    Returns:
+      ``(..., K)`` sampled values, in ``values.dtype``.
+    """
+    w = values.shape[-1]
+    x = x.astype(jnp.float32)
+    x0f = jnp.floor(x)
+    dx = (x - x0f).astype(values.dtype)
+    i0 = x0f.astype(jnp.int32)
+    i1 = i0 + 1
+
+    def gather(idx):
+        valid = (idx >= 0) & (idx < w)
+        safe = jnp.clip(idx, 0, w - 1)
+        v = jnp.take_along_axis(
+            jnp.broadcast_to(values, x.shape[:-1] + (w,)), safe, axis=-1
+        )
+        return jnp.where(valid, v, jnp.zeros_like(v))
+
+    return gather(i0) * (1 - dx) + gather(i1) * dx
+
+
+def window_taps(x: jax.Array, radius: int) -> jax.Array:
+    """Expand center coordinates ``x (...)`` into ``(..., 2r+1)`` taps ``x + [-r..r]``.
+
+    Mirrors ``dx = torch.linspace(-r, r, 2r+1)`` (core/corr.py:135): taps are in
+    ascending offset order, which fixes the channel order fed to the motion
+    encoder's 1x1 conv (core/update.py:71).
+    """
+    offsets = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    return x[..., None] + offsets
+
+
+def gather_window_2d(values: jax.Array, x: jax.Array) -> jax.Array:
+    """Sample per-row feature vectors along W with 1-D linear interpolation.
+
+    This is the memory-frugal "alt" primitive: rather than materializing the
+    O(W^2) correlation volume, sample the right feature map at the lookup taps
+    and dot with the left features (core/corr.py:72-87, where ``grid_sample``
+    with exact integer y rows degenerates to per-row 1-D interpolation).
+
+    Args:
+      values: ``(B, H, W, D)`` feature map.
+      x: ``(B, H, Q, K)`` pixel x-coordinates (per row), e.g. Q=W1, K=2r+1 taps.
+
+    Returns:
+      ``(B, H, Q, K, D)`` sampled features (zero outside ``[0, W-1]``).
+    """
+    b, h, w, d = values.shape
+    q, k = x.shape[2], x.shape[3]
+    x = x.astype(jnp.float32)
+    x0f = jnp.floor(x)
+    dx = (x - x0f).astype(values.dtype)[..., None]
+    i0 = x0f.astype(jnp.int32)
+    i1 = i0 + 1
+
+    def gather(idx):
+        valid = ((idx >= 0) & (idx < w))[..., None]
+        safe = jnp.clip(idx, 0, w - 1).reshape(b, h, q * k)
+        v = jnp.take_along_axis(values, safe[..., None], axis=2)
+        return jnp.where(valid, v.reshape(b, h, q, k, d), 0)
+
+    return gather(i0) * (1 - dx) + gather(i1) * dx

@@ -1,0 +1,52 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX import.
+
+Multi-chip sharding is validated on a host-platform device mesh
+(``--xla_force_host_platform_device_count=8``) because tests run without TPU
+hardware; the same code paths compile for a real TPU slice.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The environment's TPU plugin (axon) force-registers itself via sitecustomize
+# and rewrites jax_platforms after import; pin the test session to the 8-device
+# virtual CPU platform regardless.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+REFERENCE_DIR = "/root/reference"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(os.path.join(REFERENCE_DIR, "core"))
+
+
+requires_reference = pytest.mark.skipif(
+    not reference_available(),
+    reason="PyTorch reference checkout not available",
+)
+
+
+@pytest.fixture(scope="session")
+def torch_reference():
+    """Import the PyTorch reference as an oracle (numerical parity tests only)."""
+    if not reference_available():
+        pytest.skip("reference not available")
+    if REFERENCE_DIR not in sys.path:
+        sys.path.insert(0, REFERENCE_DIR)
+    import core.corr  # noqa: F401
+    import core.raft_stereo  # noqa: F401
+    import core  # noqa: F401
+    return core

@@ -1,0 +1,127 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_stereo_tpu.ops.geometry import (
+    InputPadder,
+    avg_pool2d,
+    coords_grid,
+    extract_3x3_patches,
+    pool2x,
+    pool_w2,
+    resize_bilinear_align_corners,
+    upflow,
+    upsample_flow_convex,
+)
+
+
+class TestCoordsGrid:
+    def test_channels_are_x_then_y(self):
+        g = coords_grid(1, 2, 3)
+        assert g.shape == (1, 2, 3, 2)
+        np.testing.assert_allclose(g[0, :, :, 0], [[0, 1, 2], [0, 1, 2]])
+        np.testing.assert_allclose(g[0, :, :, 1], [[0, 0, 0], [1, 1, 1]])
+
+
+class TestAvgPool:
+    def test_pool_w2_floor_drops_odd_tail(self):
+        x = jnp.arange(5.0).reshape(1, 1, 5, 1)
+        out = pool_w2(x)
+        np.testing.assert_allclose(out[0, 0, :, 0], [0.5, 2.5])
+
+    def test_pool2x_count_include_pad(self):
+        """3x3 s2 p1 pool divides by 9 even at padded borders (torch default)."""
+        x = jnp.ones((1, 4, 4, 1))
+        out = pool2x(x)
+        assert out.shape == (1, 2, 2, 1)
+        np.testing.assert_allclose(out[0, 0, 0, 0], 4.0 / 9.0, rtol=1e-6)
+        np.testing.assert_allclose(out[0, 1, 1, 0], 1.0, rtol=1e-6)
+
+    def test_matches_torch_avg_pool(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 7, 9, 3)).astype(np.float32)
+        got = np.asarray(pool2x(jnp.asarray(x)))
+        want = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x).permute(0, 3, 1, 2), 3, stride=2, padding=1
+        ).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestResize:
+    def test_matches_torch_interpolate_align_corners(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 5, 8, 4)).astype(np.float32)
+        got = np.asarray(resize_bilinear_align_corners(jnp.asarray(x), (10, 16)))
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x).permute(0, 3, 1, 2), (10, 16),
+            mode="bilinear", align_corners=True,
+        ).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_upflow_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((1, 3, 4, 2)).astype(np.float32)
+        got = np.asarray(upflow(jnp.asarray(x), 8))
+        want = 8 * torch.nn.functional.interpolate(
+            torch.from_numpy(x).permute(0, 3, 1, 2), (24, 32),
+            mode="bilinear", align_corners=True,
+        ).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+class TestConvexUpsample:
+    def test_patch_order_matches_unfold(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((1, 4, 5, 2)).astype(np.float32)
+        got = np.asarray(extract_3x3_patches(jnp.asarray(x)))  # (B,H,W,9,C)
+        unf = torch.nn.functional.unfold(
+            torch.from_numpy(x).permute(0, 3, 1, 2), [3, 3], padding=1
+        ).view(1, 2, 9, 4, 5).permute(0, 3, 4, 2, 1).numpy()  # (B,H,W,9,C)
+        np.testing.assert_allclose(got, unf, rtol=1e-6)
+
+    def test_matches_reference_upsample_flow(self):
+        """Full convex upsampling vs a torch transcription of
+        core/raft_stereo.py:55-67 executed as an oracle."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(6)
+        n, h, w, factor = 2, 3, 4, 4
+        flow = rng.standard_normal((n, h, w, 2)).astype(np.float32)
+        mask = rng.standard_normal((n, h, w, 9 * factor * factor)).astype(np.float32)
+
+        got = np.asarray(upsample_flow_convex(jnp.asarray(flow), jnp.asarray(mask),
+                                              factor))
+
+        tf = torch.from_numpy(flow).permute(0, 3, 1, 2)
+        tm = torch.from_numpy(mask).permute(0, 3, 1, 2)
+        tm = tm.view(n, 1, 9, factor, factor, h, w)
+        tm = torch.softmax(tm, dim=2)
+        up = torch.nn.functional.unfold(factor * tf, [3, 3], padding=1)
+        up = up.view(n, 2, 9, 1, 1, h, w)
+        up = torch.sum(tm * up, dim=2)
+        up = up.permute(0, 1, 4, 2, 5, 3)
+        want = up.reshape(n, 2, factor * h, factor * w).permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestInputPadder:
+    def test_pad_unpad_roundtrip(self):
+        x = jnp.asarray(np.random.default_rng(7).standard_normal((1, 37, 51, 3)),
+                        dtype=jnp.float32)
+        padder = InputPadder(x.shape, divis_by=32)
+        padded = padder.pad(x)
+        assert padded.shape[1] % 32 == 0 and padded.shape[2] % 32 == 0
+        np.testing.assert_allclose(padder.unpad(padded), x)
+
+    def test_already_divisible_no_pad(self):
+        x = jnp.zeros((1, 64, 96, 3))
+        padder = InputPadder(x.shape, divis_by=32)
+        assert padder.pad(x).shape == x.shape
+
+    def test_kitti_mode_pads_top(self):
+        x = jnp.zeros((1, 37, 64, 3))
+        padder = InputPadder(x.shape, mode="kitti", divis_by=32)
+        assert padder._pad == [0, 0, 0, 27]

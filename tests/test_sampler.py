@@ -1,0 +1,77 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_stereo_tpu.ops.sampler import gather_window_2d, linear_sample_1d, window_taps
+
+
+class TestLinearSample1D:
+    def test_exact_integer_coords(self):
+        v = jnp.asarray([[10.0, 20.0, 30.0, 40.0]])
+        x = jnp.asarray([[0.0, 1.0, 3.0]])
+        out = linear_sample_1d(v, x)
+        np.testing.assert_allclose(out, [[10.0, 20.0, 40.0]])
+
+    def test_fractional_interp(self):
+        v = jnp.asarray([[0.0, 10.0, 20.0]])
+        x = jnp.asarray([[0.5, 1.25]])
+        out = linear_sample_1d(v, x)
+        np.testing.assert_allclose(out, [[5.0, 12.5]], rtol=1e-6)
+
+    def test_zero_outside_range(self):
+        """grid_sample(padding_mode='zeros') semantics: OOB taps read 0."""
+        v = jnp.asarray([[10.0, 20.0]])
+        x = jnp.asarray([[-1.0, -0.5, 1.5, 2.0, 5.0]])
+        out = linear_sample_1d(v, x)
+        # -0.5: 0.5*v[-1](=0) + 0.5*v[0] = 5 ; 1.5: 0.5*v[1] + 0.5*v[2](=0) = 10
+        np.testing.assert_allclose(out, [[0.0, 5.0, 10.0, 0.0, 0.0]], rtol=1e-6)
+
+    def test_edge_coordinate_no_bleed(self):
+        """x == W-1 must return v[W-1] exactly (weight-0 OOB neighbor)."""
+        v = jnp.asarray([[1.0, 2.0, 7.0]])
+        out = linear_sample_1d(v, jnp.asarray([[2.0]]))
+        np.testing.assert_allclose(out, [[7.0]])
+
+    def test_matches_torch_grid_sample(self):
+        """Oracle check against grid_sample(align_corners=True, zeros padding),
+        the exact operator behind the reference's bilinear_sampler
+        (core/utils/utils.py:59-74) on the (N,1,1,W) collapsed corr volume."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(0)
+        n, w, k = 6, 37, 9
+        vals = rng.standard_normal((n, w)).astype(np.float32)
+        # include in-range, boundary and out-of-range coordinates
+        x = rng.uniform(-3.0, w + 2.0, size=(n, k)).astype(np.float32)
+
+        img = torch.from_numpy(vals).view(n, 1, 1, w)
+        xg = 2 * torch.from_numpy(x) / (w - 1) - 1
+        grid = torch.stack([xg, torch.zeros_like(xg)], dim=-1).view(n, k, 1, 2)
+        want = torch.nn.functional.grid_sample(
+            img, grid, align_corners=True).view(n, k).numpy()
+
+        got = np.asarray(linear_sample_1d(jnp.asarray(vals), jnp.asarray(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestWindowTaps:
+    def test_ascending_offsets(self):
+        taps = window_taps(jnp.asarray([5.0]), radius=2)
+        np.testing.assert_allclose(taps, [[3.0, 4.0, 5.0, 6.0, 7.0]])
+
+
+class TestGatherWindow2D:
+    def test_matches_manual(self):
+        rng = np.random.default_rng(1)
+        b, h, w, d = 2, 3, 11, 4
+        vals = rng.standard_normal((b, h, w, d)).astype(np.float32)
+        x = rng.uniform(-1.0, w, size=(b, h, 5, 3)).astype(np.float32)
+        got = np.asarray(gather_window_2d(jnp.asarray(vals), jnp.asarray(x)))
+        # manual per-element
+        for bi in range(b):
+            for hi in range(h):
+                flat_x = x[bi, hi].reshape(-1)
+                want = np.asarray(
+                    linear_sample_1d(jnp.asarray(vals[bi, hi].T),  # (D, W)
+                                     jnp.broadcast_to(flat_x, (d, flat_x.size)))
+                ).T.reshape(5, 3, d)
+                np.testing.assert_allclose(got[bi, hi], want, rtol=1e-5, atol=1e-6)
