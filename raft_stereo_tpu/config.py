@@ -51,6 +51,14 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
+        if len(self.hidden_dims) != 3 or self.hidden_dims[0] != self.hidden_dims[2]:
+            # The reference wires context conv i (sized hidden_dims[i]) into the
+            # GRU at level i whose hidden size is hidden_dims[2-i]
+            # (raft_stereo.py:32 vs update.py:104-106) — consistent only when
+            # hidden_dims[0] == hidden_dims[2] (conv 1 always matches gru16).
+            raise ValueError("hidden_dims must have length 3 with "
+                             "hidden_dims[0] == hidden_dims[2] "
+                             "(reference GRU/context cross-wiring)")
 
     @property
     def factor(self) -> int:
@@ -102,7 +110,7 @@ def sceneflow_config() -> tuple[RAFTStereoConfig, TrainConfig]:
     return (
         RAFTStereoConfig(mixed_precision=True),
         TrainConfig(batch_size=8, train_iters=22, num_steps=200000,
-                    spatial_scale=(-0.2, 0.4)),
+                    spatial_scale=(-0.2, 0.4), saturation_range=(0.0, 1.4)),
     )
 
 

@@ -1,0 +1,6 @@
+from raft_stereo_tpu.models.raft_stereo import (
+    RAFTStereo,
+    RefinementStep,
+    create_model,
+    init_model,
+)

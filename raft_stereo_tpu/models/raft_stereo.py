@@ -1,0 +1,182 @@
+"""RAFT-Stereo model: encoders + correlation + iterative GRU refinement.
+
+TPU-native re-design of core/raft_stereo.py: NHWC, functional flax module, and
+the refinement loop compiled as a single ``lax.scan`` over a ``(net, coords1,
+mask)`` carry (vs. the reference's Python loop, raft_stereo.py:108-136) —
+iteration count is static, the update cell is traced once, and
+``stop_gradient`` on ``coords1`` mirrors the reference's per-iteration
+``detach`` (raft_stereo.py:109). Mixed precision is a bf16 compute-dtype
+policy (no loss scaling needed on TPU) with the correlation volume kept fp32
+(reference keeps corr fp32 except under the CUDA kernels,
+raft_stereo.py:92-95).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
+from raft_stereo_tpu.nn.gru import BasicMultiUpdateBlock
+from raft_stereo_tpu.nn.layers import Conv, ResidualBlock
+from raft_stereo_tpu.ops.corr import CorrState, corr_lookup, init_corr
+from raft_stereo_tpu.ops.geometry import coords_grid, upsample_flow_convex
+
+Dtype = Any
+
+
+class RefinementStep(nn.Module):
+    """One GRU refinement iteration — the body of the ``lax.scan``.
+
+    Owns the update block's params (broadcast across scan iterations). The
+    epipolar constraint zeroes the y-component of every delta
+    (raft_stereo.py:119-120), so lookups stay on integer rows.
+    """
+
+    cfg: RAFTStereoConfig
+    test_mode: bool = False
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, carry, corr_state: CorrState, inp_list, coords0):
+        net, coords1, _ = carry
+        coords1 = jax.lax.stop_gradient(coords1)
+
+        corr = corr_lookup(corr_state, coords1)
+        flow = coords1 - coords0
+
+        cfg = self.cfg
+        dt = self.dtype
+        block = BasicMultiUpdateBlock(cfg, dtype=dt, name="update_block")
+        if cfg.slow_fast_gru and cfg.n_gru_layers == 3:
+            net = block(net, inp_list, iter32=True, iter16=False, iter08=False,
+                        update=False)
+        if cfg.slow_fast_gru and cfg.n_gru_layers >= 2:
+            net = block(net, inp_list, iter32=cfg.n_gru_layers == 3,
+                        iter16=True, iter08=False, update=False)
+        net, mask, delta_flow = block(
+            net, inp_list, corr.astype(dt) if dt else corr, flow.astype(dt) if dt else flow,
+            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2)
+
+        # stereo: project the update onto the epipolar line
+        delta_flow = delta_flow.astype(jnp.float32)
+        delta_flow = delta_flow.at[..., 1].set(0.0)
+        coords1 = coords1 + delta_flow
+
+        new_carry = (net, coords1, mask.astype(jnp.float32))
+        if self.test_mode:
+            # intermediate upsampling skipped (raft_stereo.py:126-127)
+            return new_carry, None
+        flow_up = upsample_flow_convex(coords1 - coords0,
+                                       mask.astype(jnp.float32), cfg.factor)
+        return new_carry, flow_up[..., :1]
+
+
+class RAFTStereo(nn.Module):
+    """The flagship model (core/raft_stereo.py:22-141), NHWC.
+
+    ``__call__(image1, image2)`` takes uint8-range float images ``(B, H, W, 3)``
+    and returns:
+
+    * train mode: ``(iters, B, H, W, 1)`` per-iteration upsampled disparity-flow
+      predictions (the x-component; negative disparity),
+    * test mode: ``(flow_lowres (B, H/f, W/f, 2), flow_up (B, H, W, 1))``.
+    """
+
+    cfg: RAFTStereoConfig
+    dtype: Optional[Dtype] = None
+
+    @property
+    def compute_dtype(self):
+        if self.dtype is not None:
+            return self.dtype
+        return jnp.bfloat16 if self.cfg.mixed_precision else None
+
+    @nn.compact
+    def __call__(self, image1, image2, iters: int = 12, flow_init=None,
+                 test_mode: bool = False):
+        cfg = self.cfg
+        dt = self.compute_dtype
+
+        image1 = (2.0 * (image1 / 255.0) - 1.0).astype(jnp.float32)
+        image2 = (2.0 * (image2 / 255.0) - 1.0).astype(jnp.float32)
+
+        cnet = MultiBasicEncoder(
+            output_dim=(cfg.hidden_dims, cfg.hidden_dims),
+            norm_fn=cfg.context_norm, downsample=cfg.n_downsample, dtype=dt,
+            name="cnet")
+        if cfg.shared_backbone:
+            *cnet_list, trunk = cnet(
+                jnp.concatenate([image1, image2], axis=0), dual_inp=True,
+                num_layers=cfg.n_gru_layers)
+            fmaps = Conv.make(256, 3, 1, 1, dt, "conv2_out")(
+                ResidualBlock(128, 128, "instance", 1, dt, name="conv2_res")(
+                    trunk))
+            fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+        else:
+            cnet_list = cnet(image1, num_layers=cfg.n_gru_layers)
+            fmaps = BasicEncoder(output_dim=256, norm_fn="instance",
+                                 downsample=cfg.n_downsample, dtype=dt,
+                                 name="fnet")(
+                jnp.concatenate([image1, image2], axis=0))
+            fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+
+        net_list = [jnp.tanh(x[0]) for x in cnet_list]
+        inp_list = [nn.relu(x[1]) for x in cnet_list]
+
+        # GRU context gate biases, computed once outside the refinement loop
+        # (raft_stereo.py:87-88): conv then split into (cz, cr, cq).
+        inp_list = [
+            tuple(jnp.split(
+                Conv.make(cfg.hidden_dims[i] * 3, 3, 1, 1, dt,
+                          f"context_zqr_convs_{i}")(inp), 3, axis=-1))
+            for i, inp in enumerate(inp_list)
+        ]
+
+        corr_state = init_corr(cfg.corr_implementation, fmap1, fmap2,
+                               num_levels=cfg.corr_levels,
+                               radius=cfg.corr_radius)
+
+        b, h, w, _ = net_list[0].shape
+        coords0 = coords_grid(b, h, w)
+        coords1 = coords_grid(b, h, w)
+        if flow_init is not None:
+            coords1 = coords1 + flow_init
+
+        mask_ch = 9 * cfg.factor ** 2
+        carry = (tuple(net_list), coords1,
+                 jnp.zeros((b, h, w, mask_ch), jnp.float32))
+
+        step = nn.scan(
+            RefinementStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            out_axes=0,
+            length=iters,
+        )(cfg, test_mode, dt, name="refinement")
+        carry, flow_predictions = step(carry, corr_state, tuple(inp_list),
+                                       coords0)
+        net_list, coords1, mask = carry
+
+        if test_mode:
+            flow_up = upsample_flow_convex(coords1 - coords0, mask, cfg.factor)
+            return coords1 - coords0, flow_up[..., :1]
+        return flow_predictions
+
+
+def create_model(cfg: RAFTStereoConfig, dtype: Optional[Dtype] = None) -> RAFTStereo:
+    return RAFTStereo(cfg=cfg, dtype=dtype)
+
+
+def init_model(rng, cfg: RAFTStereoConfig, image_shape=(1, 64, 96, 3),
+               dtype: Optional[Dtype] = None):
+    """Initialize model variables ({'params', 'batch_stats'}) on dummy images."""
+    model = create_model(cfg, dtype)
+    dummy = jnp.zeros(image_shape, jnp.float32)
+    variables = model.init(rng, dummy, dummy, iters=1)
+    return model, variables

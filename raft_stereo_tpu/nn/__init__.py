@@ -1,0 +1,17 @@
+from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
+from raft_stereo_tpu.nn.gru import (
+    BasicMotionEncoder,
+    BasicMultiUpdateBlock,
+    ConvGRU,
+    FlowHead,
+    SepConvGRU,
+    interp_to,
+)
+from raft_stereo_tpu.nn.layers import (
+    BottleneckBlock,
+    Conv,
+    FrozenBatchNorm,
+    GroupNorm,
+    InstanceNorm,
+    ResidualBlock,
+)

@@ -1,0 +1,135 @@
+"""Feature and context encoders (core/extractor.py, re-designed NHWC/flax).
+
+Layer naming mirrors the reference so the checkpoint converter is a pure
+renaming: ``layer1_0`` = ``layer1.0`` etc. The ``downsample`` parameter sets
+the stride pattern exactly as extractor.py:140-146: conv1 stride ``2 if
+downsample>2 else 1``, layer2 ``2 if downsample>1``, layer3 ``2 if
+downsample>0`` — so the finest feature scale is ``1/2**downsample``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from raft_stereo_tpu.nn.layers import Conv, ResidualBlock, apply_norm, make_norm
+
+Dtype = Any
+
+
+
+class _Trunk(nn.Module):
+    """Shared stem + layer1-3 trunk used by both encoders (extractor.py:140-146
+    stride pattern): conv1 stride ``2 if downsample>2``, layer2 ``2 if
+    downsample>1``, layer3 ``2 if downsample>0``."""
+
+    norm_fn: str
+    downsample: int
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        x = Conv.make(64, 7, 1 + (self.downsample > 2), 3, d, "conv1")(x)
+        x = apply_norm(make_norm(self.norm_fn, 64, num_groups=8, name="norm1"), x)
+        x = nn.relu(x)
+        x = ResidualBlock(64, 64, self.norm_fn, 1, d, name="layer1_0")(x)
+        x = ResidualBlock(64, 64, self.norm_fn, 1, d, name="layer1_1")(x)
+        x = ResidualBlock(64, 96, self.norm_fn, 1 + (self.downsample > 1), d,
+                          name="layer2_0")(x)
+        x = ResidualBlock(96, 96, self.norm_fn, 1, d, name="layer2_1")(x)
+        x = ResidualBlock(96, 128, self.norm_fn, 1 + (self.downsample > 0), d,
+                          name="layer3_0")(x)
+        x = ResidualBlock(128, 128, self.norm_fn, 1, d, name="layer3_1")(x)
+        return x
+
+
+class BasicEncoder(nn.Module):
+    """ResNet-style feature encoder (extractor.py:122-197).
+
+    7x7 stem + three 2-block residual stages (64 -> 96 -> 128) + 1x1 output
+    conv. Used as the feature network (``fnet``) with instance norm and
+    output_dim 256 (raft_stereo.py:39).
+    """
+
+    output_dim: int = 128
+    norm_fn: str = "batch"
+    downsample: int = 3
+    dropout: float = 0.0
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        d = self.dtype
+        x = _Trunk(self.norm_fn, self.downsample, d, name="trunk")(x)
+
+        x = Conv.make(self.output_dim, 1, 1, 0, d, "conv2")(x)
+        if train and self.dropout > 0:
+            x = nn.Dropout(rate=self.dropout, deterministic=False)(x)
+        return x
+
+
+class MultiBasicEncoder(nn.Module):
+    """Context encoder with multi-scale output heads (extractor.py:199-300).
+
+    The trunk is BasicEncoder's plus two more stride-2 stages (layer4/layer5).
+    Each entry of ``output_dim`` (a list of triples ordered coarse->fine, see
+    config.hidden_dims) gets one output head per scale:
+
+    * scale "08" (finest, ``1/2**downsample``): ResidualBlock + 3x3 conv to
+      ``dim[2]`` channels,
+    * scale "16": ResidualBlock + 3x3 conv to ``dim[1]``,
+    * scale "32" (coarsest): a single 3x3 conv to ``dim[0]``.
+
+    ``dual_inp=True`` runs the trunk on a doubled batch (left+right stacked)
+    and feeds only the first half to the heads, returning the full trunk
+    feature for the shared-backbone feature path (extractor.py:283-285).
+    Returns ``(outputs08[, outputs16[, outputs32]][, trunk])`` where each
+    ``outputsNN`` is a tuple with one tensor per output_dim entry.
+    """
+
+    output_dim: Sequence[Sequence[int]] = ((128,),)
+    norm_fn: str = "batch"
+    downsample: int = 3
+    dropout: float = 0.0
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x, *, dual_inp: bool = False, num_layers: int = 3,
+                 train: bool = False):
+        d = self.dtype
+        x = _Trunk(self.norm_fn, self.downsample, d, name="trunk")(x)
+
+        if dual_inp:
+            trunk = x
+            x = x[: x.shape[0] // 2]
+
+        outputs08 = tuple(self._head(x, "08", i, dim[2], d, with_res=True)
+                          for i, dim in enumerate(self.output_dim))
+        if num_layers == 1:
+            return (outputs08, trunk) if dual_inp else (outputs08,)
+
+        y = ResidualBlock(128, 128, self.norm_fn, 2, d, name="layer4_0")(x)
+        y = ResidualBlock(128, 128, self.norm_fn, 1, d, name="layer4_1")(y)
+        outputs16 = tuple(self._head(y, "16", i, dim[1], d, with_res=True)
+                          for i, dim in enumerate(self.output_dim))
+        if num_layers == 2:
+            return ((outputs08, outputs16, trunk) if dual_inp
+                    else (outputs08, outputs16))
+
+        z = ResidualBlock(128, 128, self.norm_fn, 2, d, name="layer5_0")(y)
+        z = ResidualBlock(128, 128, self.norm_fn, 1, d, name="layer5_1")(z)
+        outputs32 = tuple(self._head(z, "32", i, dim[0], d, with_res=False)
+                          for i, dim in enumerate(self.output_dim))
+        return ((outputs08, outputs16, outputs32, trunk) if dual_inp
+                else (outputs08, outputs16, outputs32))
+
+    def _head(self, x, scale: str, i: int, out_dim: int, d, *, with_res: bool):
+        """Per-scale output head; the coarsest scale has no residual block
+        (extractor.py:245-250)."""
+        if with_res:
+            x = ResidualBlock(128, 128, self.norm_fn, 1, d,
+                              name=f"outputs{scale}_{i}_res")(x)
+        return Conv.make(out_dim, 3, 1, 1, d, f"outputs{scale}_{i}_conv")(x)

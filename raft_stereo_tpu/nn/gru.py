@@ -1,0 +1,159 @@
+"""Recurrent refinement cell: ConvGRU hierarchy + motion encoder + heads.
+
+Re-design of core/update.py for NHWC/flax. The multi-level GRU stack runs
+coarse-to-fine with cross-resolution links (pool down, bilinear up), the
+motion encoder turns correlation+flow into 128-d features, and the context
+biases ``cz, cr, cq`` are precomputed once outside the refinement loop and
+*added per gate* inside each GRU (update.py:27-29, raft_stereo.py:87-88).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.nn.layers import Conv
+from raft_stereo_tpu.ops.geometry import pool2x, resize_bilinear_align_corners
+
+Dtype = Any
+
+
+class FlowHead(nn.Module):
+    """Two 3x3 convs -> delta flow (update.py:6-14)."""
+
+    hidden_dim: int = 256
+    output_dim: int = 2
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(Conv.make(self.hidden_dim, 3, 1, 1, self.dtype, "conv1")(x))
+        return Conv.make(self.output_dim, 3, 1, 1, self.dtype, "conv2")(x)
+
+
+class ConvGRU(nn.Module):
+    """Convolutional GRU with additive per-gate context biases (update.py:16-32)."""
+
+    hidden_dim: int
+    kernel_size: int = 3
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, h, cz, cr, cq, *x_list):
+        k, p = self.kernel_size, self.kernel_size // 2
+        x = jnp.concatenate(x_list, axis=-1)
+        hx = jnp.concatenate([h, x], axis=-1)
+        z = nn.sigmoid(Conv.make(self.hidden_dim, k, 1, p, self.dtype,
+                                 "convz")(hx) + cz)
+        r = nn.sigmoid(Conv.make(self.hidden_dim, k, 1, p, self.dtype,
+                                 "convr")(hx) + cr)
+        q = nn.tanh(Conv.make(self.hidden_dim, k, 1, p, self.dtype, "convq")(
+            jnp.concatenate([r * h, x], axis=-1)) + cq)
+        return (1 - z) * h + z * q
+
+
+class SepConvGRU(nn.Module):
+    """Separable (1x5 then 5x1) ConvGRU (update.py:34-62; unused by the stereo
+    model but part of the reference's component inventory)."""
+
+    hidden_dim: int = 128
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, h, *x_list):
+        x = jnp.concatenate(x_list, axis=-1)
+
+        def half(h, suffix, kernel, pad):
+            hx = jnp.concatenate([h, x], axis=-1)
+            z = nn.sigmoid(Conv.make(self.hidden_dim, kernel, 1, pad,
+                                     self.dtype, f"convz{suffix}")(hx))
+            r = nn.sigmoid(Conv.make(self.hidden_dim, kernel, 1, pad,
+                                     self.dtype, f"convr{suffix}")(hx))
+            q = nn.tanh(Conv.make(self.hidden_dim, kernel, 1, pad, self.dtype,
+                                  f"convq{suffix}")(
+                jnp.concatenate([r * h, x], axis=-1)))
+            return (1 - z) * h + z * q
+
+        h = half(h, "1", (1, 5), ((0, 0), (2, 2)))
+        h = half(h, "2", (5, 1), ((2, 2), (0, 0)))
+        return h
+
+
+class BasicMotionEncoder(nn.Module):
+    """Correlation + flow -> 128-d motion features (update.py:64-85)."""
+
+    cfg: RAFTStereoConfig
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, flow, corr):
+        d = self.dtype
+        cor = nn.relu(Conv.make(64, 1, 1, 0, d, "convc1")(corr))
+        cor = nn.relu(Conv.make(64, 3, 1, 1, d, "convc2")(cor))
+        flo = nn.relu(Conv.make(64, 7, 1, 3, d, "convf1")(flow))
+        flo = nn.relu(Conv.make(64, 3, 1, 1, d, "convf2")(flo))
+        out = nn.relu(Conv.make(128 - 2, 3, 1, 1, d, "conv")(
+            jnp.concatenate([cor, flo], axis=-1)))
+        return jnp.concatenate([out, flow], axis=-1)
+
+
+def interp_to(x, dest):
+    """Bilinear align-corners resize of ``x`` to ``dest``'s spatial shape
+    (update.py:93-95)."""
+    return resize_bilinear_align_corners(x, (dest.shape[1], dest.shape[2]))
+
+
+class BasicMultiUpdateBlock(nn.Module):
+    """3-level coarse-to-fine GRU refinement cell (update.py:97-138).
+
+    ``net`` is the hidden-state tuple ordered fine->coarse (net[0] finest);
+    ``inp`` is the per-level precomputed (cz, cr, cq) context-bias triple.
+    Flags ``iter08/16/32`` select which levels update this call; ``update=False``
+    runs GRUs only (the slow_fast_gru low-res pre-iterations,
+    raft_stereo.py:113-116).
+    """
+
+    cfg: RAFTStereoConfig
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, net: Tuple, inp: Tuple, corr=None, flow=None, *,
+                 iter08: bool = True, iter16: bool = True, iter32: bool = True,
+                 update: bool = True):
+        cfg = self.cfg
+        d = self.dtype
+        hd = cfg.hidden_dims
+        net = list(net)
+
+        if iter32:
+            net[2] = ConvGRU(hd[0], dtype=d, name="gru32")(
+                net[2], *inp[2], pool2x(net[1]))
+        if iter16:
+            if cfg.n_gru_layers > 2:
+                net[1] = ConvGRU(hd[1], dtype=d, name="gru16")(
+                    net[1], *inp[1], pool2x(net[0]), interp_to(net[2], net[1]))
+            else:
+                net[1] = ConvGRU(hd[1], dtype=d, name="gru16")(
+                    net[1], *inp[1], pool2x(net[0]))
+        if iter08:
+            motion = BasicMotionEncoder(cfg, dtype=d, name="encoder")(flow, corr)
+            if cfg.n_gru_layers > 1:
+                net[0] = ConvGRU(hd[2], dtype=d, name="gru08")(
+                    net[0], *inp[0], motion, interp_to(net[1], net[0]))
+            else:
+                net[0] = ConvGRU(hd[2], dtype=d, name="gru08")(
+                    net[0], *inp[0], motion)
+
+        if not update:
+            return tuple(net)
+
+        delta_flow = FlowHead(256, 2, dtype=d, name="flow_head")(net[0])
+
+        # scale mask to balance gradients (update.py:136-137)
+        mask = Conv.make(256, 3, 1, 1, d, "mask_conv1")(net[0])
+        mask = Conv.make(cfg.factor ** 2 * 9, 1, 1, 0, d,
+                         "mask_conv2")(nn.relu(mask))
+        return tuple(net), 0.25 * mask, delta_flow

@@ -1,0 +1,188 @@
+"""Normalization + conv building blocks (flax, NHWC).
+
+Re-designs the reference's layer vocabulary (core/extractor.py) for TPU:
+channel-last convs, fp32 params with an optional bf16 compute dtype (mixed
+precision as a dtype policy instead of torch autocast+GradScaler — bf16 needs
+no loss scaling), and *frozen* batch norm as an explicit module: the reference
+always runs BatchNorm in eval mode during training (``freeze_bn``,
+train_stereo.py:151), so its running statistics are constants and the affine
+transform is the only trainable part. That contract is made structural here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+# torch norm-layer epsilon (BatchNorm2d/InstanceNorm2d/GroupNorm all 1e-5)
+NORM_EPS = 1e-5
+
+
+def kaiming_normal_init():
+    """He-normal fan-out init (extractor.py:155-162)."""
+    return nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class Conv(nn.Conv):
+    """nn.Conv with the framework's defaults: He init, fp32 params."""
+
+    kernel_init: Callable = kaiming_normal_init()
+
+    @staticmethod
+    def make(features: int, kernel: int | Tuple[int, int], stride: int = 1,
+             padding: int | str = "SAME", dtype: Optional[Dtype] = None,
+             name: Optional[str] = None) -> "Conv":
+        if isinstance(kernel, int):
+            kernel = (kernel, kernel)
+        if isinstance(padding, int):
+            padding = ((padding, padding), (padding, padding))
+        return Conv(features=features, kernel_size=kernel,
+                    strides=(stride, stride), padding=padding, dtype=dtype,
+                    param_dtype=jnp.float32, name=name)
+
+
+class FrozenBatchNorm(nn.Module):
+    """BatchNorm with constant running statistics.
+
+    Mirrors the reference's invariant that BN never updates stats
+    (train_stereo.py:151,193: ``freeze_bn`` after every ``model.train()``):
+    ``mean``/``var`` live in the non-trainable ``batch_stats`` collection
+    (filled by the checkpoint converter; identity at fresh init), while
+    ``scale``/``bias`` are ordinary trainable params.
+    """
+
+    features: int
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        mean = self.variable("batch_stats", "mean",
+                             lambda: jnp.zeros((self.features,), jnp.float32))
+        var = self.variable("batch_stats", "var",
+                            lambda: jnp.ones((self.features,), jnp.float32))
+        scale = self.param("scale", nn.initializers.ones, (self.features,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                          jnp.float32)
+        dtype = self.dtype or x.dtype
+        inv = jax.lax.rsqrt(var.value + NORM_EPS) * scale
+        return (x * inv.astype(dtype) +
+                (bias - mean.value * inv).astype(dtype))
+
+
+class InstanceNorm(nn.Module):
+    """Per-sample, per-channel normalization over H, W.
+
+    torch ``nn.InstanceNorm2d`` defaults: no affine params, no running stats,
+    biased variance, eps 1e-5 (used by the feature encoder, raft_stereo.py:39).
+    Statistics are computed in fp32 for bf16 inputs.
+    """
+
+    features: int = 0  # unused; kept for a uniform constructor signature
+
+    @nn.compact
+    def __call__(self, x):
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+        var = jnp.var(x32, axis=(1, 2), keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + NORM_EPS)
+        return out.astype(x.dtype)
+
+
+class GroupNorm(nn.Module):
+    """GroupNorm with torch defaults (affine, eps 1e-5)."""
+
+    features: int
+    num_groups: int
+
+    @nn.compact
+    def __call__(self, x):
+        gn = nn.GroupNorm(num_groups=self.num_groups, epsilon=NORM_EPS,
+                          dtype=jnp.float32, param_dtype=jnp.float32)
+        return gn(x.astype(jnp.float32)).astype(x.dtype)
+
+
+def make_norm(norm_fn: str, features: int, *, num_groups: Optional[int] = None,
+              name: str) -> Optional[nn.Module]:
+    """Norm factory for the reference's selectable norms (extractor.py:16-38).
+
+    Returns ``None`` for ``'none'`` (callers treat it as identity). GroupNorm
+    group count defaults to ``features // 8`` (ResidualBlock) unless given
+    (BasicEncoder stem uses 8 groups, extractor.py:129).
+    """
+    if norm_fn == "none":
+        return None
+    if norm_fn == "batch":
+        return FrozenBatchNorm(features=features, name=name)
+    if norm_fn == "instance":
+        return InstanceNorm(features=features, name=name)
+    if norm_fn == "group":
+        return GroupNorm(features=features,
+                         num_groups=num_groups or features // 8, name=name)
+    raise ValueError(f"unknown norm_fn {norm_fn!r}")
+
+
+def apply_norm(norm: Optional[nn.Module], x):
+    return x if norm is None else norm(x)
+
+
+class ResidualBlock(nn.Module):
+    """Two 3x3 convs + norms with a strided 1x1 projection shortcut
+    (extractor.py:6-60). The projection exists whenever stride != 1 or the
+    channel count changes."""
+
+    in_planes: int
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = Conv.make(self.planes, 3, self.stride, 1, self.dtype, "conv1")(x)
+        y = apply_norm(make_norm(self.norm_fn, self.planes, name="norm1"), y)
+        y = nn.relu(y)
+        y = Conv.make(self.planes, 3, 1, 1, self.dtype, "conv2")(y)
+        y = apply_norm(make_norm(self.norm_fn, self.planes, name="norm2"), y)
+        y = nn.relu(y)
+
+        if not (self.stride == 1 and self.in_planes == self.planes):
+            x = Conv.make(self.planes, 1, self.stride, 0, self.dtype,
+                          "down_conv")(x)
+            x = apply_norm(make_norm(self.norm_fn, self.planes, name="norm3"), x)
+        return nn.relu(x + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (extractor.py:64-120).
+
+    Dead code in the reference (never instantiated) but part of its component
+    inventory; kept for completeness and checkpoint compatibility.
+    """
+
+    in_planes: int
+    planes: int
+    norm_fn: str = "group"
+    stride: int = 1
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        p4 = self.planes // 4
+        y = Conv.make(p4, 1, 1, 0, self.dtype, "conv1")(x)
+        y = nn.relu(apply_norm(make_norm(self.norm_fn, p4, name="norm1"), y))
+        y = Conv.make(p4, 3, self.stride, 1, self.dtype, "conv2")(y)
+        y = nn.relu(apply_norm(make_norm(self.norm_fn, p4, name="norm2"), y))
+        y = Conv.make(self.planes, 1, 1, 0, self.dtype, "conv3")(y)
+        y = nn.relu(apply_norm(make_norm(self.norm_fn, self.planes,
+                                         name="norm3"), y))
+        if self.stride != 1:
+            x = Conv.make(self.planes, 1, self.stride, 0, self.dtype,
+                          "down_conv")(x)
+            x = apply_norm(make_norm(self.norm_fn, self.planes, name="norm4"), x)
+        return nn.relu(x + y)

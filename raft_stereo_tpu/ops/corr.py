@@ -123,7 +123,7 @@ register_corr("alt", _build_alt, _lookup_alt)
 def init_corr(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,
               num_levels: int = 4, radius: int = 4) -> CorrState:
     """Build correlation state from NHWC feature maps ``(B, H, W, D)``."""
-    if impl not in _BUILDERS:
+    if impl not in _BUILDERS and impl.endswith("_pallas"):
         _maybe_register_pallas()
     if impl not in _BUILDERS:
         raise ValueError(f"unknown corr implementation {impl!r}; "
@@ -143,8 +143,20 @@ def corr_lookup(state: CorrState, coords: jax.Array) -> jax.Array:
 
 
 def _maybe_register_pallas() -> None:
-    """Lazily register the Pallas-fused implementations (import cycle guard)."""
+    """Lazily register the Pallas-fused implementations.
+
+    If the Pallas kernels are unavailable on this backend, fall back to the
+    pure-JAX implementations with the same semantics (mirrors the reference's
+    soft import of its CUDA extensions, core/corr.py:5-14) so presets like
+    realtime_config() stay runnable everywhere.
+    """
     try:
         from raft_stereo_tpu.ops.pallas import corr_kernels  # noqa: F401
     except ImportError:
-        pass
+        import warnings
+        warnings.warn("Pallas correlation kernels unavailable; "
+                      "falling back to pure-JAX reg/alt implementations")
+        if "reg_pallas" not in _BUILDERS:
+            register_corr("reg_pallas", _build_reg, _lookup_reg)
+        if "alt_pallas" not in _BUILDERS:
+            register_corr("alt_pallas", _build_alt, _lookup_alt)

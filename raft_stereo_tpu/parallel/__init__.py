@@ -1,0 +1,14 @@
+from raft_stereo_tpu.parallel.data_parallel import (
+    dryrun_train_step,
+    make_pjit_train_step,
+    make_shardmap_train_step,
+)
+from raft_stereo_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    batch_sharding,
+    batch_specs,
+    make_mesh,
+    replicated,
+    shard_batch,
+)

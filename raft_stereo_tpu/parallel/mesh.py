@@ -1,0 +1,65 @@
+"""Device-mesh construction and sharding helpers.
+
+The framework scales over a 2-D logical mesh ``('data', 'seq')``:
+
+* ``data`` — batch (data parallelism; replaces the reference's single-process
+  ``nn.DataParallel`` scatter/gather, train_stereo.py:134) with gradients
+  reduced by ``psum`` over ICI.
+* ``seq`` — image width. Stereo's memory-scaling axis is W (the O(H*W^2)
+  correlation volume; SURVEY §5 long-context row): sharding W is this model
+  family's sequence/context parallelism. XLA SPMD inserts conv halo exchanges
+  and the correlation-volume collectives from sharding annotations alone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(data_parallel: int = 0, seq_parallel: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Create a ``(data, seq)`` mesh. ``data_parallel<=0`` = use all devices.
+
+    Lays ``seq`` innermost so width-sharding collectives ride the
+    fastest-varying (ICI-adjacent) axis of the device order.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if data_parallel <= 0:
+        if len(devices) % seq_parallel:
+            raise ValueError(f"{len(devices)} devices not divisible by "
+                             f"seq_parallel={seq_parallel}")
+        data_parallel = len(devices) // seq_parallel
+    n = data_parallel * seq_parallel
+    if n > len(devices):
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(data_parallel, seq_parallel)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """NHWC batch: B over 'data', W over 'seq'."""
+    return NamedSharding(mesh, P(DATA_AXIS, None, SEQ_AXIS, None))
+
+
+def batch_specs(mesh: Mesh):
+    """Shardings for a training batch dict (image1/image2/flow/valid)."""
+    img = batch_sharding(mesh)
+    valid = NamedSharding(mesh, P(DATA_AXIS, None, SEQ_AXIS))
+    return {"image1": img, "image2": img, "flow": img, "valid": valid}
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch: dict) -> dict:
+    """Place a host batch onto the mesh with the canonical shardings."""
+    specs = batch_specs(mesh)
+    return {k: jax.device_put(v, specs[k]) for k, v in batch.items()}

@@ -1,0 +1,3 @@
+from raft_stereo_tpu.training.loss import sequence_loss
+from raft_stereo_tpu.training.optim import fetch_optimizer, one_cycle_lr
+from raft_stereo_tpu.training.state import TrainState, make_train_step

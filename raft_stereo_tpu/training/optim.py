@@ -1,0 +1,45 @@
+"""Optimizer + LR schedule (train_stereo.py:72-79), as optax transforms.
+
+AdamW (eps 1e-8, torch-default betas) under a global-norm gradient clip of 1.0
+(train_stereo.py:175) and torch's two-phase linear OneCycle schedule:
+``pct_start=0.01`` warmup from ``peak/div_factor`` to ``peak``, then linear
+anneal to ``peak/div_factor/final_div_factor``, over ``num_steps + 100`` steps
+(torch defaults div_factor=25, final_div_factor=1e4). No loss scaling: bf16 on
+TPU does not need a GradScaler.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from raft_stereo_tpu.config import TrainConfig
+
+
+def one_cycle_lr(peak_lr: float, total_steps: int, pct_start: float = 0.01,
+                 div_factor: float = 25.0, final_div_factor: float = 1e4):
+    """torch OneCycleLR(anneal_strategy='linear', cycle_momentum=False) clone.
+
+    torch's scheduler is stepped once per batch *after* the optimizer step, so
+    step k uses the LR at schedule position k (initial_lr at k=0).
+    """
+    initial_lr = peak_lr / div_factor
+    min_lr = initial_lr / final_div_factor
+    warmup_steps = max(int(round(pct_start * total_steps)) - 1, 1)
+
+    warmup = optax.linear_schedule(initial_lr, peak_lr, warmup_steps)
+    anneal = optax.linear_schedule(peak_lr, min_lr,
+                                   total_steps - 1 - warmup_steps)
+    return optax.join_schedules([warmup, anneal], [warmup_steps])
+
+
+def fetch_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """AdamW + OneCycle + global-norm clip, mirroring fetch_optimizer
+    (train_stereo.py:72-79). Weight decay applies to every parameter, as in
+    torch (the reference does not exclude norms/biases)."""
+    schedule = one_cycle_lr(cfg.lr, cfg.num_steps + 100)
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(learning_rate=schedule, b1=0.9, b2=0.999, eps=1e-8,
+                    weight_decay=cfg.wdecay),
+    )

@@ -1,0 +1,67 @@
+"""Full training state (params + frozen batch stats + optimizer + step).
+
+Unlike the reference, which checkpoints weights only and restarts the schedule
+on resume (train_stereo.py:184-186; SURVEY §5 checkpoint row), the state here
+carries everything needed for exact resume.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import optax
+from flax import struct
+
+from raft_stereo_tpu.training.loss import sequence_loss
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, variables: Dict, tx: optax.GradientTransformation):
+        params = variables["params"]
+        return cls(params=params,
+                   batch_stats=variables.get("batch_stats", {}),
+                   opt_state=tx.init(params),
+                   step=jax.numpy.zeros((), jax.numpy.int32))
+
+    @property
+    def variables(self):
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
+                    axis_name=None):
+    """Build the jittable training step.
+
+    ``batch``: dict with ``image1``/``image2`` ``(B,H,W,3)`` float images,
+    ``flow`` ``(B,H,W,1)``, ``valid`` ``(B,H,W)``. When ``axis_name`` is given
+    (shard_map data parallelism) gradients and metrics are ``psum``-reduced
+    over the mesh axis.
+    """
+
+    def train_step(state: TrainState, batch):
+        def loss_fn(params):
+            preds = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch["image1"], batch["image2"], iters=train_iters)
+            return sequence_loss(preds, batch["flow"], batch["valid"],
+                                 axis_name=axis_name)
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = jax.lax.psum(grads, axis_name)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(params=params, opt_state=opt_state,
+                                  step=state.step + 1)
+        metrics = dict(metrics, loss=loss)
+        return new_state, metrics
+
+    return train_step

@@ -1,0 +1,154 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import create_model, init_model
+
+
+SMALL = (1, 64, 96, 3)
+
+
+def _images(rng, shape=SMALL):
+    img1 = rng.uniform(0, 255, size=shape).astype(np.float32)
+    img2 = rng.uniform(0, 255, size=shape).astype(np.float32)
+    return jnp.asarray(img1), jnp.asarray(img2)
+
+
+@pytest.fixture(scope="module")
+def default_model():
+    cfg = RAFTStereoConfig()
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
+    return cfg, model, variables
+
+
+class TestForward:
+    def test_train_mode_shapes_and_finiteness(self, default_model):
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(0))
+        preds = model.apply(variables, img1, img2, iters=4)
+        assert preds.shape == (4, 1, 64, 96, 1)
+        assert bool(jnp.isfinite(preds).all())
+
+    def test_test_mode_matches_last_train_prediction(self, default_model):
+        """test_mode only skips intermediate upsampling — the final prediction
+        must be identical to train mode's last (raft_stereo.py:126-139)."""
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(1))
+        preds = model.apply(variables, img1, img2, iters=3)
+        low, up = model.apply(variables, img1, img2, iters=3, test_mode=True)
+        np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(up),
+                                   rtol=1e-5, atol=1e-5)
+        assert low.shape == (1, 16, 24, 2)
+
+    def test_iterations_refine(self, default_model):
+        """More iterations must change the prediction (the GRU is doing work)."""
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(2))
+        _, up1 = model.apply(variables, img1, img2, iters=1, test_mode=True)
+        _, up8 = model.apply(variables, img1, img2, iters=8, test_mode=True)
+        assert float(jnp.abs(up8 - up1).max()) > 1e-4
+
+    def test_flow_init_shifts_start(self, default_model):
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(3))
+        low0, _ = model.apply(variables, img1, img2, iters=1, test_mode=True)
+        finit = jnp.concatenate([jnp.full((1, 16, 24, 1), -3.0),
+                                 jnp.zeros((1, 16, 24, 1))], axis=-1)
+        low1, _ = model.apply(variables, img1, img2, iters=1, test_mode=True,
+                              flow_init=finit)
+        # starting point moved by -3 along x
+        assert float(jnp.abs((low1 - low0)[..., 0].mean() + 3.0)) < 1.0
+
+    def test_epipolar_constraint_y_flow_zero(self, default_model):
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(4))
+        low, _ = model.apply(variables, img1, img2, iters=4, test_mode=True)
+        np.testing.assert_allclose(np.asarray(low[..., 1]), 0.0, atol=1e-6)
+
+    def test_reg_and_alt_agree_end_to_end(self):
+        rng = np.random.default_rng(5)
+        img1, img2 = _images(rng)
+        outs = {}
+        for impl in ("reg", "alt"):
+            cfg = RAFTStereoConfig(corr_implementation=impl)
+            model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
+            _, outs[impl] = model.apply(variables, img1, img2, iters=4,
+                                        test_mode=True)
+        # fp differences amplify through the recurrence; allow small slack
+        np.testing.assert_allclose(np.asarray(outs["reg"]),
+                                   np.asarray(outs["alt"]), rtol=5e-3,
+                                   atol=5e-3)
+
+    def test_gradients_flow(self, default_model):
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(6))
+
+        def loss_fn(params):
+            preds = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                img1, img2, iters=2)
+            return jnp.abs(preds).mean()
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        flat = jax.tree.leaves(grads)
+        assert all(bool(jnp.isfinite(g).all()) for g in flat)
+        # the GRU convs must receive gradient through the scan
+        gru_grads = grads["refinement"]["update_block"]["gru08"]
+        assert any(float(jnp.abs(g).max()) > 0
+                   for g in jax.tree.leaves(gru_grads))
+
+
+class TestVariants:
+    @pytest.mark.parametrize("n_gru_layers", [1, 2, 3])
+    def test_gru_layer_counts(self, n_gru_layers):
+        cfg = RAFTStereoConfig(n_gru_layers=n_gru_layers)
+        model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
+        img1, img2 = _images(np.random.default_rng(7))
+        _, up = model.apply(variables, img1, img2, iters=2, test_mode=True)
+        assert up.shape == (1, 64, 96, 1)
+
+    def test_realtime_configuration(self):
+        """shared_backbone + n_downsample 3 + 2 GRU layers + slow_fast_gru
+        (README.md:105), with the pure-JAX corr impl standing in for pallas."""
+        cfg = RAFTStereoConfig(shared_backbone=True, n_downsample=3,
+                               n_gru_layers=2, slow_fast_gru=True,
+                               corr_implementation="reg")
+        model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
+        img1, img2 = _images(np.random.default_rng(8))
+        low, up = model.apply(variables, img1, img2, iters=7, test_mode=True)
+        assert low.shape == (1, 8, 12, 2)  # 1/8 resolution
+        assert up.shape == (1, 64, 96, 1)
+
+    def test_mixed_precision_bf16(self):
+        cfg = RAFTStereoConfig(mixed_precision=True)
+        model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
+        img1, img2 = _images(np.random.default_rng(9))
+        _, up = model.apply(variables, img1, img2, iters=2, test_mode=True)
+        assert up.dtype == jnp.float32  # upsampling path stays fp32
+        assert bool(jnp.isfinite(up).all())
+        # params themselves stay fp32 (policy casts activations only)
+        assert all(x.dtype == jnp.float32
+                   for x in jax.tree.leaves(variables["params"]))
+
+    def test_slow_fast_gru_changes_result(self):
+        img1, img2 = _images(np.random.default_rng(10))
+        outs = {}
+        for sf in (False, True):
+            cfg = RAFTStereoConfig(slow_fast_gru=sf)
+            model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
+            _, outs[sf] = model.apply(variables, img1, img2, iters=2,
+                                      test_mode=True)
+        assert float(jnp.abs(outs[True] - outs[False]).max()) > 1e-5
+
+    def test_jit_forward(self, default_model):
+        cfg, model, variables = default_model
+        img1, img2 = _images(np.random.default_rng(11))
+
+        @jax.jit
+        def fwd(variables, i1, i2):
+            return model.apply(variables, i1, i2, iters=2, test_mode=True)
+
+        low, up = fwd(variables, img1, img2)
+        assert up.shape == (1, 64, 96, 1)
