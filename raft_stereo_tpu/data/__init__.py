@@ -1,0 +1,32 @@
+"""Host-side data layer: codecs, augmentation, datasets, loader.
+
+Everything here runs on CPU in numpy; arrays cross to device once per step as
+a single batched transfer (vs. the reference's per-tensor ``.cuda()`` copies,
+train_stereo.py:163).
+"""
+
+from raft_stereo_tpu.data import frame_utils
+from raft_stereo_tpu.data.datasets import (
+    ETH3D,
+    KITTI,
+    FallingThings,
+    Middlebury,
+    SceneFlow,
+    SintelStereo,
+    StereoDataset,
+    TartanAir,
+    fetch_dataloader,
+)
+
+__all__ = [
+    "frame_utils",
+    "StereoDataset",
+    "SceneFlow",
+    "ETH3D",
+    "SintelStereo",
+    "FallingThings",
+    "TartanAir",
+    "KITTI",
+    "Middlebury",
+    "fetch_dataloader",
+]

@@ -1,0 +1,312 @@
+"""Photometric + spatial augmentation (capability of core/utils/augmentor.py).
+
+Same augmentation surface as the reference's ``FlowAugmentor`` /
+``SparseFlowAugmentor`` but re-designed for a deterministic host pipeline:
+
+* every random draw comes from an explicit ``np.random.Generator`` threaded
+  through the call (the reference mixes ``random``, ``np.random`` and torch
+  RNG global state, augmentor.py:53-54,86,102);
+* photometric jitter (brightness/contrast/saturation/hue/gamma) is implemented
+  directly in numpy/cv2 instead of torchvision ``ColorJitter``
+  (augmentor.py:78,200) — factor ranges match torchvision's conventions;
+* output crops are always exactly ``crop_size``: static shapes are what keep
+  XLA from recompiling per step.
+
+Behavioral spec preserved from the reference:
+  dense (FlowAugmentor, augmentor.py:60-182): asymmetric color prob 0.2;
+  eraser prob 0.5 painting 1-2 mean-color rectangles (50-100 px) into img2;
+  scale = 2**U(min_scale, max_scale) with 0.8-prob per-axis stretch
+  2**U(-0.2, 0.2), clamped so the scaled image covers crop+8; h-flip ('hf'),
+  stereo-swap flip ('h'), v-flip ('v', prob 0.1); optional yjitter crop with
+  the right image offset y±2 (imperfect rectification).
+  sparse (SparseFlowAugmentor, augmentor.py:184-317): always-symmetric color,
+  spatial prob 0.8, no stretch, scatter-based sparse flow-map resize, and a
+  margin-biased crop (y +20 / x ±50, clipped).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import cv2
+
+cv2.setNumThreads(0)
+cv2.ocl.setUseOpenCL(False)
+
+
+# ------------------------------------------------------------------ photometric
+
+def _blend(a: np.ndarray, b: np.ndarray, factor: float) -> np.ndarray:
+    return np.clip(factor * a + (1.0 - factor) * b, 0.0, 255.0)
+
+
+def _grayscale(img: np.ndarray) -> np.ndarray:
+    # ITU-R 601-2 luma, matching PIL's L conversion used by ColorJitter.
+    return img @ np.array([0.299, 0.587, 0.114], np.float32)
+
+
+def adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
+    return _blend(img, np.zeros_like(img), factor)
+
+
+def adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
+    mean = _grayscale(img).mean()
+    return _blend(img, np.full_like(img, mean), factor)
+
+
+def adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
+    gray = _grayscale(img)[..., None]
+    return _blend(img, np.broadcast_to(gray, img.shape), factor)
+
+
+def adjust_hue(img: np.ndarray, shift: float) -> np.ndarray:
+    """Shift hue by ``shift`` (fraction of a full turn, in [-0.5, 0.5])."""
+    hsv = cv2.cvtColor(img.astype(np.float32) / 255.0, cv2.COLOR_RGB2HSV)
+    hsv[..., 0] = (hsv[..., 0] + shift * 360.0) % 360.0
+    return cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB) * 255.0
+
+
+def adjust_gamma(img: np.ndarray, gamma: float, gain: float = 1.0) -> np.ndarray:
+    return np.clip(255.0 * gain * (img / 255.0) ** gamma, 0.0, 255.0)
+
+
+class PhotometricAugment:
+    """ColorJitter-equivalent: random factors, random op order, then gamma.
+
+    ``brightness``/``contrast`` give factor ranges [max(0,1-x), 1+x];
+    ``saturation`` is an explicit (lo, hi) range; ``hue`` a turn fraction
+    drawn from [-hue, hue]; ``gamma`` is (gamma_min, gamma_max, gain_min,
+    gain_max) as in the reference's AdjustGamma (augmentor.py:47-55).
+    """
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: Tuple[float, float] = (0.6, 1.4),
+                 hue: float = 0.5 / 3.14,
+                 gamma: Sequence[float] = (1, 1, 1, 1)):
+        self.brightness = (max(0.0, 1.0 - brightness), 1.0 + brightness)
+        self.contrast = (max(0.0, 1.0 - contrast), 1.0 + contrast)
+        self.saturation = tuple(saturation)
+        self.hue = hue
+        self.gamma = tuple(gamma)
+
+    def __call__(self, img: np.ndarray, rng: np.random.Generator) -> np.ndarray:
+        out = img.astype(np.float32)
+        ops = [
+            lambda x: adjust_brightness(x, rng.uniform(*self.brightness)),
+            lambda x: adjust_contrast(x, rng.uniform(*self.contrast)),
+            lambda x: adjust_saturation(x, rng.uniform(*self.saturation)),
+            lambda x: adjust_hue(x, rng.uniform(-self.hue, self.hue)),
+        ]
+        for i in rng.permutation(4):
+            out = ops[i](out)
+        g_min, g_max, gain_min, gain_max = self.gamma
+        out = adjust_gamma(out, rng.uniform(g_min, g_max),
+                           rng.uniform(gain_min, gain_max))
+        return out.astype(np.uint8)
+
+
+# ------------------------------------------------------------------ shared pieces
+
+def _eraser(img2: np.ndarray, rng: np.random.Generator,
+            bounds: Tuple[int, int] = (50, 100), prob: float = 0.5) -> np.ndarray:
+    """Occlusion simulation: paint mean-color rectangles into the right image."""
+    ht, wd = img2.shape[:2]
+    if rng.random() < prob:
+        img2 = img2.copy()
+        mean_color = img2.reshape(-1, 3).mean(axis=0)
+        for _ in range(rng.integers(1, 3)):
+            x0 = rng.integers(0, wd)
+            y0 = rng.integers(0, ht)
+            dx = rng.integers(bounds[0], bounds[1])
+            dy = rng.integers(bounds[0], bounds[1])
+            img2[y0:y0 + dy, x0:x0 + dx, :] = mean_color
+    return img2
+
+
+def _resize(img: np.ndarray, fx: float, fy: float,
+            interp=cv2.INTER_LINEAR) -> np.ndarray:
+    return cv2.resize(img, None, fx=fx, fy=fy, interpolation=interp)
+
+
+def _flips(img1, img2, flow, rng, do_flip, h_flip_prob, v_flip_prob):
+    """The reference's three flip modes (augmentor.py:137-151):
+
+    'hf' mirrors both images and negates x-flow; 'h' is the stereo-consistent
+    flip (mirror AND swap left/right, flow unchanged); 'v' flips vertically
+    with prob ``v_flip_prob`` and negates y-flow.
+    """
+    if do_flip:
+        if rng.random() < h_flip_prob and do_flip == "hf":
+            img1 = img1[:, ::-1]
+            img2 = img2[:, ::-1]
+            flow = flow[:, ::-1] * [-1.0, 1.0]
+        if rng.random() < h_flip_prob and do_flip == "h":
+            img1, img2 = img2[:, ::-1], img1[:, ::-1]
+        if rng.random() < v_flip_prob and do_flip == "v":
+            img1 = img1[::-1, :]
+            img2 = img2[::-1, :]
+            flow = flow[::-1, :] * [1.0, -1.0]
+    return img1, img2, flow
+
+
+class FlowAugmentor:
+    """Dense-GT augmentor (SceneFlow/Sintel/FallingThings/TartanAir)."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: Optional[str] = None,
+                 yjitter: bool = False,
+                 saturation_range: Tuple[float, float] = (0.6, 1.4),
+                 gamma: Sequence[float] = (1, 1, 1, 1)):
+        self.crop_size = tuple(crop_size)
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_aug_prob = 1.0
+        self.stretch_prob = 0.8
+        self.max_stretch = 0.2
+        self.yjitter = yjitter
+        self.do_flip = do_flip
+        self.h_flip_prob = 0.5
+        self.v_flip_prob = 0.1
+        self.photo = PhotometricAugment(0.4, 0.4, saturation_range,
+                                        0.5 / 3.14, gamma)
+        self.asymmetric_color_aug_prob = 0.2
+
+    def color_transform(self, img1, img2, rng):
+        if rng.random() < self.asymmetric_color_aug_prob:
+            return self.photo(img1, rng), self.photo(img2, rng)
+        stack = self.photo(np.concatenate([img1, img2], axis=0), rng)
+        out1, out2 = np.split(stack, 2, axis=0)
+        return out1, out2
+
+    def spatial_transform(self, img1, img2, flow, rng):
+        ch, cw = self.crop_size
+        ht, wd = img1.shape[:2]
+        # never scale below what the crop (plus an 8-px guard) needs
+        min_scale = max((ch + 8) / float(ht), (cw + 8) / float(wd))
+
+        scale = 2.0 ** rng.uniform(self.min_scale, self.max_scale)
+        scale_x = scale_y = scale
+        if rng.random() < self.stretch_prob:
+            scale_x *= 2 ** rng.uniform(-self.max_stretch, self.max_stretch)
+            scale_y *= 2 ** rng.uniform(-self.max_stretch, self.max_stretch)
+        scale_x = max(scale_x, min_scale)
+        scale_y = max(scale_y, min_scale)
+
+        if rng.random() < self.spatial_aug_prob:
+            img1 = _resize(img1, scale_x, scale_y)
+            img2 = _resize(img2, scale_x, scale_y)
+            flow = _resize(flow, scale_x, scale_y)
+            flow = flow * [scale_x, scale_y]
+
+        img1, img2, flow = _flips(img1, img2, flow, rng, self.do_flip,
+                                  self.h_flip_prob, self.v_flip_prob)
+
+        if self.yjitter:
+            y0 = rng.integers(2, img1.shape[0] - ch - 2)
+            x0 = rng.integers(2, img1.shape[1] - cw - 2)
+            y1 = y0 + rng.integers(-2, 3)  # imperfect-rectification jitter
+            img1 = img1[y0:y0 + ch, x0:x0 + cw]
+            img2 = img2[y1:y1 + ch, x0:x0 + cw]
+            flow = flow[y0:y0 + ch, x0:x0 + cw]
+        else:
+            y0 = rng.integers(0, img1.shape[0] - ch)
+            x0 = rng.integers(0, img1.shape[1] - cw)
+            img1 = img1[y0:y0 + ch, x0:x0 + cw]
+            img2 = img2[y0:y0 + ch, x0:x0 + cw]
+            flow = flow[y0:y0 + ch, x0:x0 + cw]
+        return img1, img2, flow
+
+    def __call__(self, img1, img2, flow, rng: np.random.Generator):
+        img1, img2 = self.color_transform(img1, img2, rng)
+        img2 = _eraser(img2, rng)
+        img1, img2, flow = self.spatial_transform(img1, img2, flow, rng)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow))
+
+
+class SparseFlowAugmentor:
+    """Sparse-GT augmentor (KITTI/ETH3D/Middlebury): scatter-resized flow maps."""
+
+    def __init__(self, crop_size: Tuple[int, int], min_scale: float = -0.2,
+                 max_scale: float = 0.5, do_flip: Optional[str] = None,
+                 yjitter: bool = False,
+                 saturation_range: Tuple[float, float] = (0.7, 1.3),
+                 gamma: Sequence[float] = (1, 1, 1, 1)):
+        del yjitter  # accepted for interface parity; sparse crops never jitter
+        self.crop_size = tuple(crop_size)
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.spatial_aug_prob = 0.8
+        self.do_flip = do_flip
+        self.h_flip_prob = 0.5
+        self.v_flip_prob = 0.1
+        self.photo = PhotometricAugment(0.3, 0.3, saturation_range,
+                                        0.3 / 3.14, gamma)
+
+    def color_transform(self, img1, img2, rng):
+        stack = self.photo(np.concatenate([img1, img2], axis=0), rng)
+        out1, out2 = np.split(stack, 2, axis=0)
+        return out1, out2
+
+    @staticmethod
+    def resize_sparse_flow_map(flow, valid, fx: float, fy: float):
+        """Resize a sparse flow field by scattering valid samples (augmentor.py:223-255)."""
+        ht, wd = flow.shape[:2]
+        xx, yy = np.meshgrid(np.arange(wd), np.arange(ht))
+        coords = np.stack([xx, yy], axis=-1).reshape(-1, 2).astype(np.float32)
+        flow_flat = flow.reshape(-1, 2).astype(np.float32)
+        keep = valid.reshape(-1) >= 1
+
+        coords1 = coords[keep] * [fx, fy]
+        flow1 = flow_flat[keep] * [fx, fy]
+
+        ht1, wd1 = int(round(ht * fy)), int(round(wd * fx))
+        xi = np.round(coords1[:, 0]).astype(np.int32)
+        yi = np.round(coords1[:, 1]).astype(np.int32)
+        inb = (xi > 0) & (xi < wd1) & (yi > 0) & (yi < ht1)
+
+        flow_img = np.zeros([ht1, wd1, 2], dtype=np.float32)
+        valid_img = np.zeros([ht1, wd1], dtype=np.int32)
+        flow_img[yi[inb], xi[inb]] = flow1[inb]
+        valid_img[yi[inb], xi[inb]] = 1
+        return flow_img, valid_img
+
+    def spatial_transform(self, img1, img2, flow, valid, rng):
+        ch, cw = self.crop_size
+        ht, wd = img1.shape[:2]
+        min_scale = max((ch + 1) / float(ht), (cw + 1) / float(wd))
+        scale = max(2.0 ** rng.uniform(self.min_scale, self.max_scale),
+                    min_scale)
+
+        if rng.random() < self.spatial_aug_prob or \
+                img1.shape[0] <= ch or img1.shape[1] <= cw:
+            img1 = _resize(img1, scale, scale)
+            img2 = _resize(img2, scale, scale)
+            flow, valid = self.resize_sparse_flow_map(flow, valid, scale, scale)
+
+        img1, img2, flow = _flips(img1, img2, flow, rng, self.do_flip,
+                                  self.h_flip_prob, self.v_flip_prob)
+
+        # margin-biased crop: favors the lower / interior image regions where
+        # sparse GT (LiDAR) actually lives (augmentor.py:291-298)
+        margin_y, margin_x = 20, 50
+        y0 = rng.integers(0, img1.shape[0] - ch + margin_y)
+        x0 = rng.integers(-margin_x, img1.shape[1] - cw + margin_x)
+        y0 = int(np.clip(y0, 0, img1.shape[0] - ch))
+        x0 = int(np.clip(x0, 0, img1.shape[1] - cw))
+
+        img1 = img1[y0:y0 + ch, x0:x0 + cw]
+        img2 = img2[y0:y0 + ch, x0:x0 + cw]
+        flow = flow[y0:y0 + ch, x0:x0 + cw]
+        valid = valid[y0:y0 + ch, x0:x0 + cw]
+        return img1, img2, flow, valid
+
+    def __call__(self, img1, img2, flow, valid, rng: np.random.Generator):
+        img1, img2 = self.color_transform(img1, img2, rng)
+        img2 = _eraser(img2, rng)
+        img1, img2, flow, valid = self.spatial_transform(img1, img2, flow,
+                                                         valid, rng)
+        return (np.ascontiguousarray(img1), np.ascontiguousarray(img2),
+                np.ascontiguousarray(flow), np.ascontiguousarray(valid))

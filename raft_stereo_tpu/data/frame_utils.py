@@ -1,0 +1,224 @@
+"""Image / disparity / flow codecs (capability of core/utils/frame_utils.py).
+
+All readers return numpy arrays (images uint8 HWC RGB; disparities float32 HW)
+— no PIL objects cross module boundaries. Dataset-specific disparity decoders
+are exposed through a small registry (`DISPARITY_READERS`) so dataset classes
+reference them by name.
+
+Format semantics (with the reference behavior each reproduces):
+
+* PFM: Pf/PF header, w h, negative scale = little-endian, rows bottom-up
+  (frame_utils.py:34-69 read, :71-81 write).
+* Middlebury .flo: magic 202021.25 float, then w, h int32, then h*w*2 float32
+  (frame_utils.py:13-32).
+* KITTI disparity PNG: 16-bit, value/256.0, 0 = invalid (frame_utils.py:124-127).
+* KITTI flow PNG: 16-bit BGR, (value-2^15)/64, third channel validity
+  (frame_utils.py:117-122, write :170-174).
+* Sintel stereo disparity: 8-bit RGB packed d = R*4 + G/64 + B/16384, paired
+  occlusion mask where 0 = valid (frame_utils.py:130-136).
+* FallingThings: uint16 depth PNG + `_camera_settings.json` fx; disparity =
+  fx * 6.0 * 100 / depth (frame_utils.py:139-146).
+* TartanAir: .npy depth; disparity = 80 / depth (frame_utils.py:149-153).
+* Middlebury GT: disp0GT.pfm + mask0nocc.png==255 nocc mask; disp0.pfm with
+  valid = disp < 1e3 (frame_utils.py:156-168).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+FLO_MAGIC = 202021.25
+
+
+# --------------------------------------------------------------------------- images
+
+def read_image(path: str) -> np.ndarray:
+    """Read an image file as uint8 (H, W, C) RGB (or (H, W) for grayscale)."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im)
+
+
+# --------------------------------------------------------------------------- PFM
+
+def read_pfm(path: str) -> np.ndarray:
+    """Read a PFM file -> float32 (H, W) or (H, W, 3), top-down row order."""
+    with open(path, "rb") as f:
+        header = f.readline().rstrip()
+        if header == b"PF":
+            channels = 3
+        elif header == b"Pf":
+            channels = 1
+        else:
+            raise ValueError(f"{path}: not a PFM file (header {header!r})")
+
+        dims = f.readline()
+        m = re.match(rb"^(\d+)\s+(\d+)\s*$", dims)
+        if not m:
+            raise ValueError(f"{path}: malformed PFM dims {dims!r}")
+        width, height = int(m.group(1)), int(m.group(2))
+
+        scale = float(f.readline().rstrip())
+        endian = "<" if scale < 0 else ">"
+
+        data = np.fromfile(f, endian + "f4", count=height * width * channels)
+    if data.size != height * width * channels:
+        raise ValueError(f"{path}: truncated PFM payload")
+    shape = (height, width, 3) if channels == 3 else (height, width)
+    # PFM stores rows bottom-to-top.
+    return np.flipud(data.reshape(shape)).astype(np.float32)
+
+
+def write_pfm(path: str, array: np.ndarray) -> None:
+    """Write a single-channel float32 PFM (little-endian, bottom-up rows)."""
+    if array.ndim != 2:
+        raise ValueError("write_pfm supports single-channel (H, W) arrays")
+    h, w = array.shape
+    with open(path, "wb") as f:
+        f.write(b"Pf\n")
+        f.write(f"{w} {h}\n".encode())
+        f.write(b"-1\n")
+        f.write(np.flipud(array).astype("<f4").tobytes())
+
+
+# --------------------------------------------------------------------------- .flo
+
+def read_flo(path: str) -> np.ndarray:
+    """Read Middlebury .flo optical flow -> float32 (H, W, 2)."""
+    with open(path, "rb") as f:
+        magic = np.fromfile(f, np.float32, count=1)
+        if magic.size != 1 or magic[0] != np.float32(FLO_MAGIC):
+            raise ValueError(f"{path}: bad .flo magic {magic!r}")
+        w = int(np.fromfile(f, np.int32, count=1)[0])
+        h = int(np.fromfile(f, np.int32, count=1)[0])
+        data = np.fromfile(f, np.float32, count=2 * w * h)
+    return data.reshape(h, w, 2)
+
+
+def write_flo(path: str, flow: np.ndarray) -> None:
+    flow = np.asarray(flow, np.float32)
+    h, w, c = flow.shape
+    if c != 2:
+        raise ValueError("flow must be (H, W, 2)")
+    with open(path, "wb") as f:
+        np.float32(FLO_MAGIC).tofile(f)
+        np.int32(w).tofile(f)
+        np.int32(h).tofile(f)
+        flow.tofile(f)
+
+
+# --------------------------------------------------------------------------- KITTI PNGs
+
+def _read_png_16bit(path: str) -> np.ndarray:
+    import cv2
+
+    img = cv2.imread(path, cv2.IMREAD_ANYDEPTH | cv2.IMREAD_UNCHANGED)
+    if img is None:
+        raise FileNotFoundError(path)
+    return img
+
+
+def read_disp_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    disp = _read_png_16bit(path).astype(np.float32) / 256.0
+    return disp, disp > 0.0
+
+
+def read_flow_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    import cv2
+
+    raw = cv2.imread(path, cv2.IMREAD_ANYDEPTH | cv2.IMREAD_COLOR)
+    if raw is None:
+        raise FileNotFoundError(path)
+    raw = raw[:, :, ::-1].astype(np.float32)  # BGR -> RGB channel order
+    flow = (raw[:, :, :2] - 2.0 ** 15) / 64.0
+    valid = raw[:, :, 2]
+    return flow, valid
+
+
+def write_flow_kitti(path: str, flow: np.ndarray) -> None:
+    import cv2
+
+    enc = 64.0 * np.asarray(flow, np.float64) + 2 ** 15
+    valid = np.ones(enc.shape[:2] + (1,))
+    out = np.concatenate([enc, valid], axis=-1).astype(np.uint16)
+    cv2.imwrite(path, out[..., ::-1])
+
+
+# ----------------------------------------------------------------- dataset decoders
+
+def read_disp_sintel(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    rgb = read_image(path).astype(np.float32)
+    disp = rgb[..., 0] * 4.0 + rgb[..., 1] / 64.0 + rgb[..., 2] / 16384.0
+    occ_path = path.replace("disparities", "occlusions")
+    occlusion = read_image(occ_path)
+    valid = (occlusion == 0) & (disp > 0)
+    return disp, valid
+
+
+def read_disp_falling_things(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    depth = read_image(path).astype(np.float32)
+    settings = os.path.join(os.path.dirname(path), "_camera_settings.json")
+    with open(settings) as f:
+        intrinsics = json.load(f)
+    fx = intrinsics["camera_settings"][0]["intrinsic_settings"]["fx"]
+    with np.errstate(divide="ignore"):
+        disp = (fx * 6.0 * 100.0) / depth
+    return disp, disp > 0
+
+
+def read_disp_tartanair(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    depth = np.load(path)
+    with np.errstate(divide="ignore"):
+        disp = 80.0 / depth.astype(np.float32)
+    return disp, disp > 0
+
+
+def read_disp_middlebury(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    name = os.path.basename(path)
+    disp = read_pfm(path)
+    if disp.ndim != 2:
+        raise ValueError(f"{path}: expected single-channel disparity")
+    if name == "disp0GT.pfm":
+        nocc_path = path.replace("disp0GT.pfm", "mask0nocc.png")
+        valid = read_image(nocc_path) == 255
+        return disp, valid
+    return disp, disp < 1e3
+
+
+def read_disp_pfm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Generic PFM disparity (SceneFlow): finite values are valid."""
+    disp = read_pfm(path)
+    if disp.ndim == 3:
+        disp = disp[..., 0]
+    return disp, np.isfinite(disp)
+
+
+DISPARITY_READERS: Dict[str, Callable[[str], Tuple[np.ndarray, np.ndarray]]] = {
+    "pfm": read_disp_pfm,
+    "kitti": read_disp_kitti,
+    "sintel": read_disp_sintel,
+    "falling_things": read_disp_falling_things,
+    "tartanair": read_disp_tartanair,
+    "middlebury": read_disp_middlebury,
+}
+
+
+def read_gen(path: str) -> np.ndarray:
+    """Extension-dispatched reader (frame_utils.py:177-191): images, .flo, .pfm, .npy."""
+    ext = os.path.splitext(path)[-1].lower()
+    if ext in (".png", ".jpeg", ".jpg", ".ppm"):
+        return read_image(path)
+    if ext in (".bin", ".raw", ".npy"):
+        return np.load(path)
+    if ext == ".flo":
+        return read_flo(path)
+    if ext == ".pfm":
+        data = read_pfm(path)
+        return data if data.ndim == 2 else data[:, :, :-1]
+    raise ValueError(f"unsupported extension {ext!r} for {path}")

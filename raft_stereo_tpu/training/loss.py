@@ -60,7 +60,12 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
     weights = adjusted_gamma ** jnp.arange(n_predictions - 1, -1, -1,
                                            dtype=jnp.float32)
 
+    # Guard masked-out pixels BEFORE multiplying by the mask: a non-finite GT
+    # value (e.g. inf disparity from zero depth) would otherwise poison the
+    # sum as inf * 0 = nan. The reference sidesteps this with boolean
+    # fancy-indexing (train_stereo.py:56), unavailable under jit.
     abs_err = jnp.abs(flow_preds.astype(jnp.float32) - flow_gt[None])
+    abs_err = jnp.where(mask[None] > 0, abs_err, 0.0)
     per_iter = jnp.einsum("nbhwc,bhwc->n", abs_err, mask)
     if axis_name is not None:
         per_iter = jax.lax.psum(per_iter, axis_name)
@@ -69,6 +74,7 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
     epe = jnp.sqrt(jnp.sum(
         (flow_preds[-1].astype(jnp.float32) - flow_gt) ** 2, axis=-1))
     m = mask[..., 0]
+    epe = jnp.where(m > 0, epe, 0.0)
     epe_sum = global_sum(epe * m)
     metrics = {
         "epe": epe_sum / denom,

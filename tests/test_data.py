@@ -1,0 +1,264 @@
+"""Data layer tests: codecs round-trip, augmentor invariants, datasets, loader."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.data import frame_utils
+from raft_stereo_tpu.data.augment import (
+    FlowAugmentor,
+    PhotometricAugment,
+    SparseFlowAugmentor,
+)
+from raft_stereo_tpu.data.datasets import SceneFlow, StereoDataset
+from raft_stereo_tpu.data.loader import Loader
+
+
+# ------------------------------------------------------------------- codecs
+
+def test_pfm_roundtrip(tmp_path):
+    arr = np.random.default_rng(0).normal(size=(13, 17)).astype(np.float32)
+    path = str(tmp_path / "x.pfm")
+    frame_utils.write_pfm(path, arr)
+    out = frame_utils.read_pfm(path)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_pfm_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.pfm")
+    with open(path, "wb") as f:
+        f.write(b"JUNK\n1 1\n-1\n\x00\x00\x00\x00")
+    with pytest.raises(ValueError):
+        frame_utils.read_pfm(path)
+
+
+def test_flo_roundtrip(tmp_path):
+    flow = np.random.default_rng(1).normal(size=(7, 9, 2)).astype(np.float32)
+    path = str(tmp_path / "x.flo")
+    frame_utils.write_flo(path, flow)
+    np.testing.assert_array_equal(frame_utils.read_flo(path), flow)
+
+
+def test_kitti_disp_roundtrip(tmp_path):
+    import cv2
+
+    disp = np.zeros((5, 6), np.float32)
+    disp[2, 3] = 42.5
+    path = str(tmp_path / "d.png")
+    cv2.imwrite(path, (disp * 256).astype(np.uint16))
+    out, valid = frame_utils.read_disp_kitti(path)
+    assert out[2, 3] == pytest.approx(42.5)
+    assert valid[2, 3] and not valid[0, 0]
+
+
+def test_kitti_flow_roundtrip(tmp_path):
+    flow = np.random.default_rng(2).uniform(-64, 64, (5, 6, 2)).astype(np.float32)
+    flow = np.round(flow * 64) / 64  # representable at 1/64 px
+    path = str(tmp_path / "f.png")
+    frame_utils.write_flow_kitti(path, flow)
+    out, valid = frame_utils.read_flow_kitti(path)
+    np.testing.assert_allclose(out, flow, atol=1 / 64)
+    assert valid.all()
+
+
+def test_sintel_disp_decode(tmp_path):
+    # d = R*4 + G/64 + B/16384
+    rgb = np.zeros((4, 5, 3), np.uint8)
+    rgb[1, 2] = (10, 32, 0)  # 40 + 0.5
+    (tmp_path / "disparities").mkdir()
+    (tmp_path / "occlusions").mkdir()
+    from PIL import Image
+
+    Image.fromarray(rgb).save(tmp_path / "disparities" / "frame_0.png")
+    occ = np.zeros((4, 5), np.uint8)
+    occ[0, 0] = 255
+    Image.fromarray(occ).save(tmp_path / "occlusions" / "frame_0.png")
+    disp, valid = frame_utils.read_disp_sintel(
+        str(tmp_path / "disparities" / "frame_0.png"))
+    assert disp[1, 2] == pytest.approx(40.5)
+    assert valid[1, 2]
+    assert not valid[0, 0]  # occluded
+
+
+def test_falling_things_decode(tmp_path):
+    from PIL import Image
+
+    depth = np.full((3, 4), 3000, np.uint16)
+    Image.fromarray(depth).save(tmp_path / "left.depth.png")
+    with open(tmp_path / "_camera_settings.json", "w") as f:
+        json.dump({"camera_settings":
+                   [{"intrinsic_settings": {"fx": 768.0}}]}, f)
+    disp, valid = frame_utils.read_disp_falling_things(
+        str(tmp_path / "left.depth.png"))
+    assert disp[0, 0] == pytest.approx(768.0 * 600 / 3000)
+    assert valid.all()
+
+
+def test_tartanair_decode(tmp_path):
+    depth = np.full((3, 4), 16.0, np.float32)
+    np.save(tmp_path / "left_depth.npy", depth)
+    disp, valid = frame_utils.read_disp_tartanair(
+        str(tmp_path / "left_depth.npy"))
+    assert disp[0, 0] == pytest.approx(5.0)
+
+
+def test_middlebury_decode(tmp_path):
+    disp = np.random.default_rng(3).uniform(1, 50, (6, 8)).astype(np.float32)
+    frame_utils.write_pfm(str(tmp_path / "disp0GT.pfm"), disp)
+    from PIL import Image
+
+    mask = np.full((6, 8), 255, np.uint8)
+    mask[0, 0] = 128
+    Image.fromarray(mask).save(tmp_path / "mask0nocc.png")
+    out, valid = frame_utils.read_disp_middlebury(str(tmp_path / "disp0GT.pfm"))
+    np.testing.assert_allclose(out, disp, rtol=1e-6)
+    assert not valid[0, 0] and valid[1, 1]
+
+
+# ------------------------------------------------------------------- augment
+
+def test_photometric_preserves_shape_dtype():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (40, 50, 3), dtype=np.uint8)
+    out = PhotometricAugment()(img, rng)
+    assert out.shape == img.shape and out.dtype == np.uint8
+
+
+def test_flow_augmentor_static_output_shape():
+    rng = np.random.default_rng(0)
+    img1 = rng.integers(0, 255, (200, 300, 3), dtype=np.uint8)
+    img2 = rng.integers(0, 255, (200, 300, 3), dtype=np.uint8)
+    flow = rng.normal(size=(200, 300, 2)).astype(np.float32)
+    aug = FlowAugmentor(crop_size=(96, 128), yjitter=True)
+    for _ in range(5):
+        o1, o2, of = aug(img1, img2, flow, rng)
+        assert o1.shape == (96, 128, 3)
+        assert o2.shape == (96, 128, 3)
+        assert of.shape == (96, 128, 2)
+
+
+def test_flow_augmentor_deterministic():
+    img1 = np.random.default_rng(7).integers(
+        0, 255, (150, 200, 3), dtype=np.uint8)
+    img2 = img1.copy()
+    flow = np.ones((150, 200, 2), np.float32)
+    aug = FlowAugmentor(crop_size=(64, 96))
+    a = aug(img1, img2, flow, np.random.default_rng(42))
+    b = aug(img1, img2, flow, np.random.default_rng(42))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_flow_augmentor_scales_flow_values():
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 255, (100, 150, 3), dtype=np.uint8)
+    flow = np.full((100, 150, 2), 4.0, np.float32)
+    aug = FlowAugmentor(crop_size=(64, 96), min_scale=1.0, max_scale=1.0)
+    aug.stretch_prob = 0.0
+    _, _, of = aug(img, img.copy(), flow, rng)
+    np.testing.assert_allclose(of[..., 0], 8.0, rtol=1e-5)  # 2**1 scale
+
+
+def test_sparse_augmentor_shapes_and_valid():
+    rng = np.random.default_rng(0)
+    img1 = rng.integers(0, 255, (200, 300, 3), dtype=np.uint8)
+    img2 = rng.integers(0, 255, (200, 300, 3), dtype=np.uint8)
+    flow = rng.normal(size=(200, 300, 2)).astype(np.float32)
+    valid = (rng.random((200, 300)) > 0.5).astype(np.float32)
+    aug = SparseFlowAugmentor(crop_size=(96, 128))
+    o1, o2, of, ov = aug(img1, img2, flow, valid, rng)
+    assert o1.shape == (96, 128, 3) and of.shape == (96, 128, 2)
+    assert ov.shape == (96, 128)
+    assert set(np.unique(ov)).issubset({0, 1})
+
+
+def test_sparse_resize_scatters_scaled_values():
+    flow = np.zeros((10, 12, 2), np.float32)
+    valid = np.zeros((10, 12), np.float32)
+    flow[5, 6] = (-3.0, 0.0)
+    valid[5, 6] = 1
+    out_flow, out_valid = SparseFlowAugmentor.resize_sparse_flow_map(
+        flow, valid, fx=2.0, fy=2.0)
+    assert out_flow.shape == (20, 24, 2)
+    assert out_valid[10, 12] == 1
+    np.testing.assert_allclose(out_flow[10, 12], (-6.0, 0.0))
+    assert out_valid.sum() == 1
+
+
+# ------------------------------------------------------------------- datasets
+
+def _make_sceneflow_tree(root, n=3, h=96, w=128):
+    """Synthetic FlyingThings3D layout with matching PFM disparities."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    base = root / "FlyingThings3D"
+    for i in range(n):
+        for side in ("left", "right"):
+            d = base / "frames_cleanpass" / "TRAIN" / "A" / f"{i:04d}" / side
+            d.mkdir(parents=True, exist_ok=True)
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(d / "0006.png")
+        dd = base / "disparity" / "TRAIN" / "A" / f"{i:04d}" / "left"
+        dd.mkdir(parents=True, exist_ok=True)
+        disp = rng.uniform(1, 30, (h, w)).astype(np.float32)
+        frame_utils.write_pfm(str(dd / "0006.pfm"), disp)
+    return root
+
+
+def test_sceneflow_dataset_sample(tmp_path):
+    _make_sceneflow_tree(tmp_path)
+    ds = SceneFlow(aug_params={"crop_size": (64, 96)}, root=str(tmp_path))
+    assert len(ds) == 3
+    s = ds.sample(0, np.random.default_rng(0))
+    assert s["image1"].shape == (64, 96, 3)
+    assert s["flow"].shape == (64, 96, 1)
+    assert s["valid"].shape == (64, 96)
+    assert (s["flow"] <= 0).all()  # flow = -disparity
+
+
+def test_dataset_mul_add_composition(tmp_path):
+    _make_sceneflow_tree(tmp_path)
+    a = SceneFlow(aug_params=None, root=str(tmp_path))
+    combined = (a * 2) + (a * 3)
+    assert len(combined) == 15
+    s = combined.sample(14, np.random.default_rng(0))
+    assert s["image1"].shape[-1] == 3
+
+
+def test_dataset_unaugmented_valid_mask(tmp_path):
+    _make_sceneflow_tree(tmp_path)
+    ds = SceneFlow(aug_params=None, root=str(tmp_path))
+    s = ds.sample(1, np.random.default_rng(0))
+    assert s["valid"].all()  # all synthetic disparities < 512
+
+
+# ------------------------------------------------------------------- loader
+
+def test_loader_batches_and_determinism(tmp_path):
+    _make_sceneflow_tree(tmp_path, n=5)
+    ds = SceneFlow(aug_params={"crop_size": (32, 48)}, root=str(tmp_path))
+    loader_a = Loader(ds, batch_size=2, seed=3, num_workers=2)
+    batches_a = list(loader_a)
+    assert len(batches_a) == 2  # drop_last: 5 // 2
+    for b in batches_a:
+        assert b["image1"].shape == (2, 32, 48, 3)
+        assert b["flow"].shape == (2, 32, 48, 1)
+
+    loader_b = Loader(ds, batch_size=2, seed=3, num_workers=4)
+    batches_b = list(loader_b)
+    # determinism must not depend on worker count
+    for ba, bb in zip(batches_a, batches_b):
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k])
+
+
+def test_loader_epochs_differ(tmp_path):
+    _make_sceneflow_tree(tmp_path, n=4)
+    ds = SceneFlow(aug_params={"crop_size": (32, 48)}, root=str(tmp_path))
+    loader = Loader(ds, batch_size=4, seed=0, num_workers=2)
+    e0 = next(iter(loader))
+    e1 = next(iter(loader))
+    assert not np.array_equal(e0["image1"], e1["image1"])

@@ -1,0 +1,174 @@
+"""Tests for the training substrate: loss, optimizer schedule, train steps,
+and the dp+sp parallel paths on the 8-device virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.loss import sequence_loss
+from raft_stereo_tpu.training.optim import fetch_optimizer, one_cycle_lr
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+
+# ------------------------------------------------------------------- loss
+
+def test_sequence_loss_perfect_prediction():
+    gt = jnp.ones((2, 8, 10, 1)) * -3.0
+    preds = jnp.broadcast_to(gt[None], (4,) + gt.shape)
+    valid = jnp.ones((2, 8, 10))
+    loss, metrics = sequence_loss(preds, gt, valid)
+    assert float(loss) == pytest.approx(0.0)
+    assert float(metrics["epe"]) == pytest.approx(0.0)
+    assert float(metrics["1px"]) == pytest.approx(1.0)
+
+
+def test_sequence_loss_weighting_favors_late_iterations():
+    gt = jnp.zeros((1, 4, 4, 1))
+    valid = jnp.ones((1, 4, 4))
+    # error only in the FIRST iteration vs only in the LAST
+    early = jnp.zeros((3, 1, 4, 4, 1)).at[0].set(1.0)
+    late = jnp.zeros((3, 1, 4, 4, 1)).at[-1].set(1.0)
+    loss_early, _ = sequence_loss(early, gt, valid)
+    loss_late, _ = sequence_loss(late, gt, valid)
+    assert float(loss_late) > float(loss_early)
+
+
+def test_sequence_loss_nonfinite_gt_masked_out():
+    """A masked-out inf GT pixel (e.g. disparity 80/0 from zero depth) must
+    not poison the loss: inf * 0 would be nan without the where-guard."""
+    gt = jnp.zeros((1, 4, 4, 1)).at[0, 1, 1, 0].set(jnp.inf)
+    preds = jnp.zeros((2, 1, 4, 4, 1))
+    valid = jnp.ones((1, 4, 4))
+    loss, metrics = sequence_loss(preds, gt, valid)
+    assert jnp.isfinite(loss)
+    assert jnp.isfinite(metrics["epe"])
+    # 15 of 16 pixels are perfect; the inf pixel is excluded by the mag mask
+    assert float(metrics["1px"]) == pytest.approx(1.0)
+
+
+def test_sequence_loss_invalid_pixels_excluded():
+    gt = jnp.full((1, 4, 4, 1), -2.0)
+    preds = jnp.zeros((1, 1, 4, 4, 1))  # epe 2 everywhere
+    valid = jnp.zeros((1, 4, 4)).at[0, 0, 0].set(1.0)
+    _, metrics = sequence_loss(preds, gt, valid)
+    assert float(metrics["epe"]) == pytest.approx(2.0)
+    assert float(metrics["3px"]) == pytest.approx(1.0)
+
+
+def test_sequence_loss_gamma_adjustment():
+    """gamma_adj = 0.9 ** (15/(n-1)): n=16 gives 0.9 per-step decay."""
+    gt = jnp.zeros((1, 2, 2, 1))
+    valid = jnp.ones((1, 2, 2))
+    preds = jnp.ones((16, 1, 2, 2, 1))
+    loss, _ = sequence_loss(preds, gt, valid)
+    expected = sum(0.9 ** (15 - i) for i in range(16))
+    assert float(loss) == pytest.approx(expected, rel=1e-5)
+
+
+# ------------------------------------------------------------------- optim
+
+def test_one_cycle_lr_shape():
+    sched = one_cycle_lr(peak_lr=1e-3, total_steps=1000, pct_start=0.01)
+    warm = [float(sched(i)) for i in range(0, 12)]
+    assert warm[0] < warm[5] < warm[10]  # warmup rises
+    peak_step = int(0.01 * 1001)
+    assert float(sched(peak_step)) == pytest.approx(1e-3, rel=1e-2)
+    assert float(sched(999)) < 1e-4  # annealed near zero
+    assert float(sched(1500)) >= 0.0  # past-end queries stay finite
+
+
+def test_fetch_optimizer_steps():
+    tcfg = TrainConfig(num_steps=50, lr=1e-3, wdecay=1e-5, batch_size=2)
+    tx = fetch_optimizer(tcfg)
+    params = {"w": jnp.ones((4, 4))}
+    state = tx.init(params)
+    grads = {"w": jnp.ones((4, 4))}
+    updates, state = tx.update(grads, state, params)
+    assert jnp.isfinite(updates["w"]).all()
+
+
+# ------------------------------------------------------------------- train step
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    cfg = RAFTStereoConfig()
+    tcfg = TrainConfig(num_steps=10, batch_size=2)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (2, 32, 48, 1)), jnp.float32),
+        "valid": jnp.ones((2, 32, 48), jnp.float32),
+    }
+    return model, tx, state, batch
+
+
+def test_train_step_updates_params_and_metrics(tiny_setup):
+    model, tx, state, batch = tiny_setup
+    step = jax.jit(make_train_step(model, tx, train_iters=2))
+    new_state, metrics = step(state, batch)
+    assert int(new_state.step) == 1
+    assert jnp.isfinite(metrics["loss"])
+    assert jnp.isfinite(metrics["epe"])
+    # at least some parameters moved
+    moved = jax.tree_util.tree_reduce(
+        lambda acc, pair: acc or bool(jnp.any(pair[0] != pair[1])),
+        jax.tree.map(lambda a, b: (a, b), state.params, new_state.params),
+        False)
+    assert moved
+
+
+# ------------------------------------------------------------------- parallel
+
+def test_dryrun_multichip_8dev():
+    """The driver's multi-chip validation path: dp x sp pjit step and
+    explicit shard_map DP step, one step each on the virtual 8-CPU mesh."""
+    from raft_stereo_tpu.parallel import dryrun_train_step
+
+    dryrun_train_step(8)
+
+
+def test_shardmap_dp_matches_single_device():
+    """psum-reduced DP gradients must equal the single-device gradients."""
+    from raft_stereo_tpu.parallel.mesh import make_mesh, replicated
+    from raft_stereo_tpu.parallel.data_parallel import make_shardmap_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = RAFTStereoConfig()
+    tcfg = TrainConfig(num_steps=10, batch_size=4, lr=1e-4)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+
+    rng = np.random.default_rng(1)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (4, 32, 48, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (4, 32, 48, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (4, 32, 48, 1)), jnp.float32),
+        "valid": jnp.ones((4, 32, 48), jnp.float32),
+    }
+
+    single = jax.jit(make_train_step(model, tx, train_iters=1))
+    ref_state, ref_metrics = single(jax.tree.map(jnp.array, state), batch)
+
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])
+    with mesh:
+        st = jax.device_put(jax.tree.map(jnp.array, state), replicated(mesh))
+        sharded_batch = {k: jax.device_put(
+            v, NamedSharding(mesh, P("data"))) for k, v in batch.items()}
+        dp_step = make_shardmap_train_step(model, tx, 1, mesh)
+        dp_state, dp_metrics = dp_step(st, sharded_batch)
+
+    assert float(dp_metrics["loss"]) == pytest.approx(
+        float(ref_metrics["loss"]), rel=1e-4)
+    leaves_ref = jax.tree_util.tree_leaves(ref_state.params)
+    leaves_dp = jax.tree_util.tree_leaves(dp_state.params)
+    for a, b in zip(leaves_ref, leaves_dp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
