@@ -1,0 +1,102 @@
+"""Driver benchmark: SceneFlow-recipe training throughput, stereo-pairs/sec/chip.
+
+Runs the flagship RAFTStereo training step with the reference's published
+SceneFlow recipe (batch 8, 22 train iters, n_downsample 2, mixed precision —
+reference README.md:130) on synthetic data with the training crop size
+(320x720, train_stereo.py:228), and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "pairs/sec/chip", "vs_baseline": N/20}
+
+Baseline: the driver's north-star target of 20 stereo-pairs/sec/chip
+(BASELINE.json). On non-TPU hosts a reduced shape is used so the benchmark
+stays runnable; the JSON notes the platform so numbers are not comparable
+across platforms.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+BASELINE_PAIRS_PER_SEC_PER_CHIP = 20.0
+
+
+def main():
+    platform = jax.devices()[0].platform
+    n_chips = jax.device_count()
+    on_tpu = platform == "tpu"
+
+    # SceneFlow recipe (README.md:130); reduced shapes keep CPU smoke runs fast.
+    if on_tpu:
+        batch, (h, w), train_iters, steps = 8, (320, 720), 22, 6
+    else:
+        batch, (h, w), train_iters, steps = 2, (96, 160), 4, 3
+
+    cfg = RAFTStereoConfig(mixed_precision=True)
+    tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
+                       num_steps=200000, image_size=(h, w))
+
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+
+    rng = np.random.default_rng(1234)
+    batch_data = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-64, 0, (batch, h, w, 1)), jnp.float32),
+        "valid": jnp.ones((batch, h, w), jnp.float32),
+    }
+
+    if n_chips > 1:
+        # shard the step over all chips so pairs/sec/chip is meaningful
+        from raft_stereo_tpu.parallel.data_parallel import make_pjit_train_step
+        from raft_stereo_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+        mesh = make_mesh(n_chips, 1)
+        state = jax.device_put(state, replicated(mesh))
+        batch_data = shard_batch(mesh, batch_data)
+        step = make_pjit_train_step(model, tx, train_iters, mesh)
+    else:
+        step = jax.jit(make_train_step(model, tx, train_iters),
+                       donate_argnums=(0,))
+
+    # Warmup: compile + one steady-state step. The loss fetch (device->host
+    # transfer of an executable output) is the synchronization point: on
+    # tunneled TPU devices (axon), block_until_ready has been observed to
+    # return before queued executions finish, but a host transfer of an output
+    # scalar cannot complete until its executable does.
+    state, _ = step(state, batch_data)
+    state, metrics = step(state, batch_data)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+        float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    pairs_per_sec = batch * steps / dt
+    per_chip = pairs_per_sec / n_chips
+    print(json.dumps({
+        "metric": "sceneflow_train_throughput",
+        "value": round(per_chip, 3),
+        "unit": "pairs/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
+        "platform": platform,
+        "batch": batch,
+        "train_iters": train_iters,
+        "image_size": [h, w],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Inference demo CLI (reference demo.py:55-78, same flag surface).
+
+Globs left/right image pairs, runs the model in test mode, writes
+``<name>-disparity.png`` jet-colormapped visualizations and optionally raw
+``.npy`` disparities (reference demo.py:34-52).
+"""
+
+import argparse
+import glob
+import logging
+import os
+
+import numpy as np
+
+from raft_stereo_tpu import cli
+from raft_stereo_tpu.inference import StereoPredictor
+
+
+def load_image(path):
+    from PIL import Image
+    img = np.asarray(Image.open(path)).astype(np.uint8)
+    if img.ndim == 2:
+        img = np.tile(img[..., None], (1, 1, 3))
+    return img[..., :3]
+
+
+def save_colormapped(path, disparity):
+    import matplotlib.pyplot as plt
+    plt.imsave(path, disparity, cmap="jet")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU demo")
+    parser.add_argument("--restore_ckpt", required=True,
+                        help="reference .pth or orbax state dir")
+    parser.add_argument("-l", "--left_imgs", required=True,
+                        help="glob for left images")
+    parser.add_argument("-r", "--right_imgs", required=True,
+                        help="glob for right images")
+    parser.add_argument("--output_directory", default="demo_output")
+    parser.add_argument("--save_numpy", action="store_true",
+                        help="also save raw .npy disparities")
+    parser.add_argument("--valid_iters", type=int, default=32)
+    cli.add_model_args(parser)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+
+    cfg = cli.model_config(args)
+    model, variables = cli.load_variables(args.restore_ckpt, cfg)
+    predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters)
+
+    left_list = sorted(glob.glob(args.left_imgs, recursive=True))
+    right_list = sorted(glob.glob(args.right_imgs, recursive=True))
+    if not left_list or len(left_list) != len(right_list):
+        raise SystemExit(f"found {len(left_list)} left / {len(right_list)} "
+                         "right images; need matching non-empty lists")
+    print(f"found {len(left_list)} image pairs; saving files to "
+          f"{args.output_directory}/")
+    os.makedirs(args.output_directory, exist_ok=True)
+
+    for lpath, rpath in zip(left_list, right_list):
+        disp = predictor.compute_disparity(load_image(lpath),
+                                           load_image(rpath))
+        stem = os.path.join(args.output_directory,
+                            os.path.splitext(os.path.basename(lpath))[0])
+        save_colormapped(f"{stem}-disparity.png", disp)
+        if args.save_numpy:
+            np.save(f"{stem}.npy", disp)
+        print(f"{lpath}: disparity range "
+              f"[{disp.min():.2f}, {disp.max():.2f}]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Shared CLI flag surface -> dataclass configs.
+
+The reference triplicates its argparse declarations across train/eval/demo
+(train_stereo.py:214-249, evaluate_stereo.py:192-209, demo.py:55-75). Here the
+flag names — the de-facto public API — are declared once and parsed into
+:class:`RAFTStereoConfig` / :class:`TrainConfig`.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+
+
+def add_model_args(parser: argparse.ArgumentParser) -> None:
+    """Architecture choices (identical flag group in all three reference CLIs)."""
+    g = parser.add_argument_group("architecture")
+    g.add_argument("--hidden_dims", nargs="+", type=int, default=[128, 128, 128],
+                   help="hidden state and context dimensions")
+    g.add_argument("--corr_implementation",
+                   choices=["reg", "alt", "reg_cuda", "alt_cuda",
+                            "reg_pallas", "alt_pallas"], default="reg",
+                   help="correlation volume implementation "
+                        "(*_cuda aliases map to the *_pallas TPU kernels)")
+    g.add_argument("--shared_backbone", action="store_true",
+                   help="use a single backbone for context and feature nets")
+    g.add_argument("--corr_levels", type=int, default=4)
+    g.add_argument("--corr_radius", type=int, default=4)
+    g.add_argument("--n_downsample", type=int, default=2,
+                   help="resolution of the disparity field (1/2^K)")
+    g.add_argument("--context_norm",
+                   choices=["group", "batch", "instance", "none"],
+                   default="batch")
+    g.add_argument("--slow_fast_gru", action="store_true",
+                   help="iterate the low-res GRUs more frequently")
+    g.add_argument("--n_gru_layers", type=int, default=3)
+    g.add_argument("--mixed_precision", action="store_true",
+                   help="bf16 compute dtype (no loss scaling needed on TPU)")
+    g.add_argument("--no_remat", action="store_true",
+                   help="disable refinement-loop rematerialization "
+                        "(faster, much more HBM)")
+
+
+def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
+    return RAFTStereoConfig(
+        hidden_dims=tuple(args.hidden_dims),
+        corr_implementation=args.corr_implementation,
+        shared_backbone=args.shared_backbone,
+        corr_levels=args.corr_levels,
+        corr_radius=args.corr_radius,
+        n_downsample=args.n_downsample,
+        context_norm=args.context_norm,
+        slow_fast_gru=args.slow_fast_gru,
+        n_gru_layers=args.n_gru_layers,
+        mixed_precision=args.mixed_precision,
+        remat_refinement=not getattr(args, "no_remat", False),
+    )
+
+
+def add_train_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--name", default="raft-stereo",
+                        help="name your experiment")
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="orbax state dir or reference .pth")
+    parser.add_argument("--batch_size", type=int, default=6)
+    parser.add_argument("--train_datasets", nargs="+", default=["sceneflow"])
+    parser.add_argument("--lr", type=float, default=0.0002)
+    parser.add_argument("--num_steps", type=int, default=100000)
+    parser.add_argument("--image_size", type=int, nargs="+",
+                        default=[320, 720])
+    parser.add_argument("--train_iters", type=int, default=16)
+    parser.add_argument("--valid_iters", type=int, default=32)
+    parser.add_argument("--wdecay", type=float, default=1e-5)
+    g = parser.add_argument_group("data augmentation")
+    g.add_argument("--img_gamma", type=float, nargs="+", default=None)
+    g.add_argument("--saturation_range", type=float, nargs="+", default=None)
+    g.add_argument("--do_flip", choices=["h", "v"], default=None)
+    g.add_argument("--spatial_scale", type=float, nargs="+", default=[0, 0])
+    g.add_argument("--noyjitter", action="store_true")
+    o = parser.add_argument_group("ours")
+    o.add_argument("--data_root", default="datasets")
+    o.add_argument("--ckpt_dir", default="checkpoints")
+    o.add_argument("--validation_frequency", type=int, default=10000)
+    o.add_argument("--num_workers", type=int, default=4)
+    o.add_argument("--seed", type=int, default=1234)
+    o.add_argument("--data_parallel", type=int, default=0,
+                   help="data-parallel shards (<=0: all devices)")
+    o.add_argument("--seq_parallel", type=int, default=1,
+                   help="width (sequence) parallel shards")
+
+
+def train_config(args: argparse.Namespace) -> TrainConfig:
+    return TrainConfig(
+        name=args.name,
+        restore_ckpt=args.restore_ckpt,
+        batch_size=args.batch_size,
+        train_datasets=tuple(args.train_datasets),
+        lr=args.lr,
+        num_steps=args.num_steps,
+        image_size=tuple(args.image_size),
+        train_iters=args.train_iters,
+        valid_iters=args.valid_iters,
+        wdecay=args.wdecay,
+        img_gamma=tuple(args.img_gamma) if args.img_gamma else None,
+        saturation_range=(tuple(args.saturation_range)
+                          if args.saturation_range else None),
+        do_flip=args.do_flip,
+        spatial_scale=tuple(args.spatial_scale),
+        noyjitter=args.noyjitter,
+        data_root=args.data_root,
+        seed=args.seed,
+        ckpt_dir=args.ckpt_dir,
+        validation_frequency=args.validation_frequency,
+        num_workers=args.num_workers,
+        data_parallel=args.data_parallel,
+        seq_parallel=args.seq_parallel,
+    )
+
+
+def load_variables(restore_ckpt: Optional[str], cfg: RAFTStereoConfig,
+                   image_shape=(1, 64, 96, 3)):
+    """Init a model and (optionally) load weights from .pth or orbax state."""
+    import jax
+
+    from raft_stereo_tpu.models import init_model
+
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, image_shape)
+    if restore_ckpt is None:
+        return model, variables
+    if restore_ckpt.endswith((".pth", ".pth.gz")):
+        from raft_stereo_tpu.utils.checkpoint_convert import (
+            load_reference_checkpoint, validate_against_variables)
+        converted = load_reference_checkpoint(restore_ckpt)
+        return model, validate_against_variables(converted, variables)
+    from raft_stereo_tpu.training.checkpoint import restore_train_state
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState
+
+    state = TrainState.create(variables, fetch_optimizer(TrainConfig()))
+    restored = restore_train_state(restore_ckpt, jax.device_get(state))
+    return model, {"params": restored.params,
+                   "batch_stats": restored.batch_stats}

@@ -40,6 +40,10 @@ class RAFTStereoConfig:
     slow_fast_gru: bool = False
     n_gru_layers: int = 3
     mixed_precision: bool = False
+    # Ours: rematerialize each refinement iteration in the backward pass
+    # (jax.checkpoint). Without it the scan stores every iteration's conv
+    # activations and SceneFlow-shape training OOMs on a 16 GB chip.
+    remat_refinement: bool = True
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)

@@ -130,25 +130,38 @@ def _resize(img: np.ndarray, fx: float, fy: float,
     return cv2.resize(img, None, fx=fx, fy=fy, interpolation=interp)
 
 
-def _flips(img1, img2, flow, rng, do_flip, h_flip_prob, v_flip_prob):
+def _flips(img1, img2, flow, rng, do_flip, h_flip_prob, v_flip_prob,
+           valid=None):
     """The reference's three flip modes (augmentor.py:137-151):
 
     'hf' mirrors both images and negates x-flow; 'h' is the stereo-consistent
     flip (mirror AND swap left/right, flow unchanged); 'v' flips vertically
     with prob ``v_flip_prob`` and negates y-flow.
+
+    ``valid`` (sparse GT) is flipped together with ``flow`` — a fix over the
+    reference, which leaves the sparse validity mask unflipped (reference
+    augmentor.py spatial_transform) and so silently supervises mirrored
+    positions against the wrong mask. Dense callers pass ``valid=None``
+    (their validity is recomputed from the flipped flow afterwards).
     """
     if do_flip:
         if rng.random() < h_flip_prob and do_flip == "hf":
             img1 = img1[:, ::-1]
             img2 = img2[:, ::-1]
             flow = flow[:, ::-1] * [-1.0, 1.0]
+            if valid is not None:
+                valid = valid[:, ::-1]
         if rng.random() < h_flip_prob and do_flip == "h":
             img1, img2 = img2[:, ::-1], img1[:, ::-1]
         if rng.random() < v_flip_prob and do_flip == "v":
             img1 = img1[::-1, :]
             img2 = img2[::-1, :]
             flow = flow[::-1, :] * [1.0, -1.0]
-    return img1, img2, flow
+            if valid is not None:
+                valid = valid[::-1, :]
+    if valid is None:
+        return img1, img2, flow
+    return img1, img2, flow, valid
 
 
 class FlowAugmentor:
@@ -286,8 +299,9 @@ class SparseFlowAugmentor:
             img2 = _resize(img2, scale, scale)
             flow, valid = self.resize_sparse_flow_map(flow, valid, scale, scale)
 
-        img1, img2, flow = _flips(img1, img2, flow, rng, self.do_flip,
-                                  self.h_flip_prob, self.v_flip_prob)
+        img1, img2, flow, valid = _flips(img1, img2, flow, rng, self.do_flip,
+                                         self.h_flip_prob, self.v_flip_prob,
+                                         valid=valid)
 
         # margin-biased crop: favors the lower / interior image regions where
         # sparse GT (LiDAR) actually lives (augmentor.py:291-298)

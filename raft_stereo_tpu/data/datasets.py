@@ -131,7 +131,10 @@ class StereoDataset:
         src = owner[index] if owner is not None else self
         if src.augmentor is not None:
             if rng is None:
-                rng = np.random.default_rng()
+                # Deterministic by construction: deriving from the index keeps
+                # ad-hoc sample() calls reproducible instead of silently
+                # breaking the data layer's determinism contract.
+                rng = np.random.default_rng(np.random.Philox(key=index))
             if src.sparse:
                 img1, img2, flow, valid = src.augmentor(img1, img2, flow,
                                                         valid, rng)

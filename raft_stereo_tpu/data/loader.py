@@ -47,6 +47,10 @@ class Loader:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.epoch = 0
+        if len(self) == 0:
+            raise ValueError(
+                f"dataset of {len(dataset)} samples yields no batches at "
+                f"batch_size={batch_size} (drop_last={drop_last})")
 
     def __len__(self) -> int:
         n = len(self.dataset) // self.batch_size

@@ -1,0 +1,9 @@
+from raft_stereo_tpu.eval.validate import (
+    validate_eth3d,
+    validate_kitti,
+    validate_middlebury,
+    validate_things,
+)
+
+__all__ = ["validate_eth3d", "validate_kitti", "validate_middlebury",
+           "validate_things"]

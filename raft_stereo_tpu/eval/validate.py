@@ -1,0 +1,161 @@
+"""Dataset validators (evaluate_stereo.py:19-189, re-built on the JAX stack).
+
+Each validator shares the reference skeleton: load pair -> pad to /32 ->
+``model(test_mode=True)`` -> unpad -> EPE against GT flow, with the
+dataset-specific metric definitions:
+
+* ETH3D: bad-1px "D1" (evaluate_stereo.py:42)
+* KITTI: bad-3px, plus wall-clock FPS after a warmup (evaluate_stereo.py:77-107)
+* FlyingThings: bad-1px over pixels with ``|disp| < 192`` (:133-135)
+* Middlebury: bad-2px over the nocc mask (:173-175; the reference's
+  ``valid >= -0.5`` check is a no-op on the 0/1 mask — replicated faithfully,
+  so the effective filter is ``gt > -1000`` plus the occlusion mask via
+  ``valid``)
+
+All metric arithmetic happens in numpy on the host — the device computes only
+the forward pass, via :class:`raft_stereo_tpu.inference.StereoPredictor`
+(which buckets shapes to bound recompiles).
+"""
+
+from __future__ import annotations
+
+import logging
+import os.path as osp
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from raft_stereo_tpu.data import datasets
+from raft_stereo_tpu.inference import StereoPredictor
+
+logger = logging.getLogger(__name__)
+
+
+def _epe(flow_pred: np.ndarray, flow_gt: np.ndarray) -> np.ndarray:
+    """Per-pixel endpoint error between (H, W, C) flows (C=1: |dx|)."""
+    return np.sqrt(np.sum((flow_pred - flow_gt) ** 2, axis=-1))
+
+
+def _predict(predictor: StereoPredictor, sample, iters: int):
+    img1 = sample["image1"][None]
+    img2 = sample["image2"][None]
+    flow_up = predictor(img1, img2, iters)  # (1, H, W, 1)
+    return flow_up[0]
+
+
+def validate_eth3d(predictor: StereoPredictor, root: str = "datasets",
+                   iters: int = 32) -> Dict[str, float]:
+    """ETH3D two-view validation: EPE + bad-1px (evaluate_stereo.py:19-56)."""
+    ds = datasets.ETH3D(root=osp.join(root, "ETH3D"))
+    if len(ds) == 0:
+        raise ValueError(f"no samples found under {root!r}")
+    epe_list, out_list = [], []
+    for i in range(len(ds)):
+        sample = ds.sample(i)
+        flow_pr = _predict(predictor, sample, iters)
+        flow_gt = sample["flow"]
+        valid = sample["valid"] >= 0.5
+        epe = _epe(flow_pr, flow_gt)
+        epe_list.append(epe[valid].mean().item())
+        out_list.append((epe > 1.0)[valid])
+    epe = float(np.mean(epe_list))
+    d1 = 100 * float(np.concatenate(out_list).mean())
+    logger.info("Validation ETH3D: EPE %f, D1 %f", epe, d1)
+    return {"eth3d-epe": epe, "eth3d-d1": d1}
+
+
+def validate_kitti(predictor: StereoPredictor, root: str = "datasets",
+                   iters: int = 32,
+                   warmup_frames: int = 50) -> Dict[str, float]:
+    """KITTI-15 training-split validation: EPE + bad-3px + FPS
+    (evaluate_stereo.py:59-108). Timing starts after ``warmup_frames`` images
+    like the reference's cudnn-autotune warmup; synchronization is by host
+    fetch (the prediction returned by the predictor is already on host)."""
+    ds = datasets.KITTI(root=osp.join(root, "KITTI"), image_set="training")
+    if len(ds) == 0:
+        raise ValueError(f"no samples found under {root!r}")
+    epe_list, out_list, elapsed = [], [], []
+    for i in range(len(ds)):
+        sample = ds.sample(i)
+        t0 = time.perf_counter()
+        flow_pr = _predict(predictor, sample, iters)
+        dt = time.perf_counter() - t0
+        if i >= warmup_frames:
+            elapsed.append(dt)
+        flow_gt = sample["flow"]
+        valid = sample["valid"] >= 0.5
+        epe = _epe(flow_pr, flow_gt)
+        epe_list.append(epe[valid].mean().item())
+        out_list.append(((epe > 3.0) & valid)[valid])
+    epe = float(np.mean(epe_list))
+    d1 = 100 * float(np.concatenate(out_list).mean())
+    result = {"kitti-epe": epe, "kitti-d1": d1}
+    if elapsed:
+        result["kitti-fps"] = 1.0 / float(np.mean(elapsed))
+        logger.info("Validation KITTI: EPE %f, D1 %f, %f FPS",
+                    epe, d1, result["kitti-fps"])
+    else:
+        logger.info("Validation KITTI: EPE %f, D1 %f", epe, d1)
+    return result
+
+
+def validate_things(predictor: StereoPredictor, root: str = "datasets",
+                    iters: int = 32,
+                    max_disp: float = 192.0) -> Dict[str, float]:
+    """FlyingThings3D TEST split: EPE + bad-1px over ``|disp| < max_disp``
+    (evaluate_stereo.py:111-146). Doubles as the in-training validation hook
+    (train_stereo.py:188)."""
+    ds = datasets.SceneFlow(root=root, dstype="frames_finalpass",
+                            things_test=True)
+    if len(ds) == 0:
+        raise ValueError(f"no samples found under {root!r}")
+    epe_list, out_list = [], []
+    for i in range(len(ds)):
+        sample = ds.sample(i)
+        flow_pr = _predict(predictor, sample, iters)
+        flow_gt = sample["flow"]
+        epe = _epe(flow_pr, flow_gt)
+        valid = (sample["valid"] >= 0.5) & \
+                (np.abs(flow_gt[..., 0]) < max_disp)
+        epe_list.append(epe[valid].mean().item())
+        out_list.append((epe > 1.0)[valid])
+    epe = float(np.mean(epe_list))
+    d1 = 100 * float(np.concatenate(out_list).mean())
+    logger.info("Validation FlyingThings: EPE %f, D1 %f", epe, d1)
+    return {"things-epe": epe, "things-d1": d1}
+
+
+def validate_middlebury(predictor: StereoPredictor, root: str = "datasets",
+                        iters: int = 32,
+                        split: str = "F") -> Dict[str, float]:
+    """Middlebury MiddEval3 validation: EPE + bad-2px (evaluate_stereo.py:149-189).
+
+    ``split`` in {'F','H','Q'}; the occlusion handling replicates the
+    reference: the nocc mask is loaded as ``valid`` and the only extra filter
+    is ``gt > -1000`` (evaluate_stereo.py:173-175).
+    """
+    ds = datasets.Middlebury(root=osp.join(root, "Middlebury"), split=split)
+    if len(ds) == 0:
+        raise ValueError(f"no samples found under {root!r}")
+    epe_list, out_list = [], []
+    for i in range(len(ds)):
+        sample = ds.sample(i)
+        flow_pr = _predict(predictor, sample, iters)
+        flow_gt = sample["flow"]
+        epe = _epe(flow_pr, flow_gt)
+        valid = (sample["valid"] >= 0.5) & (flow_gt[..., 0] > -1000)
+        epe_list.append(epe[valid].mean().item())
+        out_list.append((epe > 2.0)[valid])
+    epe = float(np.mean(epe_list))
+    d1 = 100 * float(np.concatenate(out_list).mean())
+    logger.info("Validation Middlebury%s: EPE %f, D1 %f", split, epe, d1)
+    return {f"middlebury{split}-epe": epe, f"middlebury{split}-d1": d1}
+
+
+VALIDATORS = {
+    "eth3d": validate_eth3d,
+    "kitti": validate_kitti,
+    "things": validate_things,
+    "middlebury": validate_middlebury,
+}

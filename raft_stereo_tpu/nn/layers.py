@@ -95,16 +95,28 @@ class InstanceNorm(nn.Module):
 
 
 class GroupNorm(nn.Module):
-    """GroupNorm with torch defaults (affine, eps 1e-5)."""
+    """GroupNorm with torch defaults (affine, eps 1e-5).
+
+    Params (``scale``/``bias``) live directly on this module so the checkpoint
+    converter maps torch ``normX.weight/bias`` to a uniform flax path.
+    """
 
     features: int
     num_groups: int
 
     @nn.compact
     def __call__(self, x):
-        gn = nn.GroupNorm(num_groups=self.num_groups, epsilon=NORM_EPS,
-                          dtype=jnp.float32, param_dtype=jnp.float32)
-        return gn(x.astype(jnp.float32)).astype(x.dtype)
+        scale = self.param("scale", nn.initializers.ones, (self.features,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                          jnp.float32)
+        x32 = x.astype(jnp.float32)
+        b, h, w, c = x32.shape
+        g = x32.reshape(b, h, w, self.num_groups, c // self.num_groups)
+        mean = jnp.mean(g, axis=(1, 2, 4), keepdims=True)
+        var = jnp.var(g, axis=(1, 2, 4), keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + NORM_EPS)).reshape(b, h, w, c)
+        return (out * scale + bias).astype(x.dtype)
 
 
 def make_norm(norm_fn: str, features: int, *, num_groups: Optional[int] = None,

@@ -9,7 +9,7 @@ and convex upsampling is 9 static shifts + an einsum instead of ``F.unfold``.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -159,10 +159,20 @@ class InputPadder:
     padding goes to the bottom. Replicate padding, exact unpad.
     """
 
-    def __init__(self, dims: Sequence[int], mode: str = "sintel", divis_by: int = 8):
+    def __init__(self, dims: Sequence[int], mode: str = "sintel",
+                 divis_by: int = 8, target: "Optional[Tuple[int, int]]" = None):
         self.ht, self.wd = dims[-3], dims[-2]  # NHWC
-        pad_ht = (((self.ht // divis_by) + 1) * divis_by - self.ht) % divis_by
-        pad_wd = (((self.wd // divis_by) + 1) * divis_by - self.wd) % divis_by
+        if target is not None:
+            # pad to an explicit (H, W) bucket >= the image, to bound the
+            # number of distinct compiled shapes during evaluation
+            th, tw = target
+            if th < self.ht or tw < self.wd:
+                raise ValueError(f"target {target} smaller than image "
+                                 f"({self.ht}, {self.wd})")
+            pad_ht, pad_wd = th - self.ht, tw - self.wd
+        else:
+            pad_ht = (((self.ht // divis_by) + 1) * divis_by - self.ht) % divis_by
+            pad_wd = (((self.wd // divis_by) + 1) * divis_by - self.wd) % divis_by
         if mode == "sintel":
             self._pad = [pad_wd // 2, pad_wd - pad_wd // 2,
                          pad_ht // 2, pad_ht - pad_ht // 2]

@@ -1,0 +1,48 @@
+"""Full-state checkpointing (orbax): params + opt state + step.
+
+The reference saves weights only and silently restarts the LR schedule on
+resume (train_stereo.py:184-186; SURVEY §5). Here a checkpoint restores model
+params, frozen batch stats, optimizer state, and the step counter (which also
+positions the OneCycle schedule and, in the trainer, repositions the loader's
+epoch counter — individual intra-epoch sample order is not restored).
+
+Weights-only interop with reference ``.pth`` files lives in
+:mod:`raft_stereo_tpu.utils.checkpoint_convert`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save_train_state(ckpt_dir: str, name: str, state: Any,
+                     step: Optional[int] = None) -> str:
+    """Save the full TrainState; returns the checkpoint path.
+
+    Layout mirrors the reference naming: ``<ckpt_dir>/<step>_<name>`` for
+    periodic saves, ``<ckpt_dir>/<name>`` for the final one
+    (train_stereo.py:184-186, 208-209).
+    """
+    tag = name if step is None else f"{step}_{name}"
+    path = os.path.abspath(os.path.join(ckpt_dir, tag))
+    state = jax.device_get(state)
+    _checkpointer().save(path, state, force=True)
+    return path
+
+
+def restore_train_state(path: str, target: Any) -> Any:
+    """Restore a TrainState saved by :func:`save_train_state`.
+
+    ``target`` supplies the pytree structure/dtypes (a freshly created state).
+    """
+    restored = _checkpointer().restore(os.path.abspath(path), item=target)
+    return restored

@@ -66,7 +66,7 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
     # fancy-indexing (train_stereo.py:56), unavailable under jit.
     abs_err = jnp.abs(flow_preds.astype(jnp.float32) - flow_gt[None])
     abs_err = jnp.where(mask[None] > 0, abs_err, 0.0)
-    per_iter = jnp.einsum("nbhwc,bhwc->n", abs_err, mask)
+    per_iter = jnp.sum(abs_err, axis=(1, 2, 3, 4))
     if axis_name is not None:
         per_iter = jax.lax.psum(per_iter, axis_name)
     flow_loss = jnp.sum(weights * per_iter) / denom
@@ -75,7 +75,7 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
         (flow_preds[-1].astype(jnp.float32) - flow_gt) ** 2, axis=-1))
     m = mask[..., 0]
     epe = jnp.where(m > 0, epe, 0.0)
-    epe_sum = global_sum(epe * m)
+    epe_sum = global_sum(epe)
     metrics = {
         "epe": epe_sum / denom,
         "1px": global_sum((epe < 1.0) * m) / denom,

@@ -1,0 +1,159 @@
+"""The training loop (train_stereo.py:132-211, rebuilt for the JAX stack).
+
+One function, :func:`train`, wires together: device mesh + sharded train step
+(:mod:`raft_stereo_tpu.parallel`), the deterministic threaded loader, the
+OneCycle/AdamW optimizer, step-windowed logging, periodic full-state
+checkpoints, and the validate-on-Things hook every ``validation_frequency``
+steps (train_stereo.py:183-190). Differences from the reference, by design:
+
+* full-state checkpoints (exact resume, incl. schedule position) via orbax;
+  ``--restore_ckpt`` also accepts reference ``.pth`` files (weights-only),
+* no GradScaler: bf16 needs no loss scaling; grad-clip 1.0 is kept,
+* BatchNorm is frozen structurally (nn/layers.py) — no ``freeze_bn`` dance.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.data.datasets import fetch_dataloader
+from raft_stereo_tpu.data.loader import infinite_batches
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.parallel.data_parallel import make_pjit_train_step
+from raft_stereo_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+from raft_stereo_tpu.training.checkpoint import (restore_train_state,
+                                                 save_train_state)
+from raft_stereo_tpu.training.logger import Logger
+from raft_stereo_tpu.training.optim import fetch_optimizer, one_cycle_lr
+from raft_stereo_tpu.training.state import TrainState
+
+logger = logging.getLogger(__name__)
+
+
+def _restore(path: str, state: TrainState, model_cfg: RAFTStereoConfig,
+             variables) -> TrainState:
+    """Restore either a full orbax state dir or a reference .pth (weights)."""
+    if path.endswith((".pth", ".pth.gz")):
+        from raft_stereo_tpu.utils.checkpoint_convert import (
+            load_reference_checkpoint, validate_against_variables)
+        converted = load_reference_checkpoint(path)
+        converted = validate_against_variables(converted, variables)
+        logger.info("restored reference weights from %s", path)
+        return state.replace(params=converted["params"],
+                             batch_stats=converted["batch_stats"])
+    restored = restore_train_state(path, jax.device_get(state))
+    logger.info("restored full train state from %s (step %s)",
+                path, int(restored.step))
+    return restored
+
+
+def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
+          validate_every: Optional[int] = None) -> str:
+    """Run training to ``cfg.num_steps``; returns the final checkpoint path."""
+    validation_frequency = validate_every or cfg.validation_frequency
+    os.makedirs(cfg.ckpt_dir, exist_ok=True)
+
+    mesh = make_mesh(cfg.data_parallel, cfg.seq_parallel)
+    n_dev = mesh.devices.size
+    if cfg.batch_size % max(mesh.shape["data"], 1):
+        raise ValueError(f"batch_size {cfg.batch_size} not divisible by "
+                         f"data-parallel size {mesh.shape['data']}")
+    logger.info("mesh: %s devices (%s)", n_dev, dict(mesh.shape))
+
+    h, w = cfg.image_size
+    model, variables = init_model(jax.random.PRNGKey(cfg.seed), model_cfg,
+                                  (1, h, w, 3))
+    n_params = sum(np.prod(p.shape) for p in jax.tree.leaves(
+        variables["params"]))
+    logger.info("parameter count: %d", n_params)
+
+    tx = fetch_optimizer(cfg)
+    state = TrainState.create(variables, tx)
+    if cfg.restore_ckpt:
+        state = _restore(cfg.restore_ckpt, state, model_cfg, variables)
+
+    loader = fetch_dataloader(cfg)
+    if int(state.step):
+        # reposition the data stream's epoch to match the restored step
+        # (intra-epoch order is not restored; see training/checkpoint.py)
+        loader.epoch = int(state.step) // max(len(loader), 1)
+    schedule = one_cycle_lr(cfg.lr, cfg.num_steps + 100)
+
+    with mesh:
+        state = jax.device_put(state, replicated(mesh))
+        step_fn = make_pjit_train_step(model, tx, cfg.train_iters, mesh)
+
+        log = Logger(total_steps=int(state.step))
+        t_start, imgs_done = time.perf_counter(), 0
+        for batch in infinite_batches(loader):
+            global_step = int(state.step)
+            if global_step >= cfg.num_steps:
+                break
+            placed = shard_batch(mesh, batch)
+            state, metrics = step_fn(state, placed)
+            # host fetch = step synchronization + metric values
+            metrics = {k: float(v) for k, v in metrics.items()}
+            imgs_done += cfg.batch_size
+            log.push(metrics, lr=float(schedule(global_step)))
+            global_step += 1
+
+            if global_step % validation_frequency == 0:
+                ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
+                                        step=global_step)
+                logger.info("saved %s", ckpt)
+                predictor = _get_validation_predictor(model_cfg, state, cfg)
+                results = _maybe_validate_things(predictor, cfg)
+                if results:
+                    log.write_dict(results)
+                dt = time.perf_counter() - t_start
+                logger.info("throughput: %.2f pairs/sec over last window",
+                            imgs_done / max(dt, 1e-9))
+                t_start, imgs_done = time.perf_counter(), 0
+
+        final = save_train_state(cfg.ckpt_dir, cfg.name, state)
+        log.close()
+    logger.info("training done: %s", final)
+    return final
+
+
+_validation_predictor = None
+
+
+def _get_validation_predictor(model_cfg: RAFTStereoConfig, state: TrainState,
+                              cfg: TrainConfig):
+    """One predictor per run, its jit cache reused across validation passes;
+    only the weights are refreshed each time."""
+    global _validation_predictor
+    from raft_stereo_tpu.inference import StereoPredictor
+    variables = jax.device_get(state.variables)
+    if _validation_predictor is None or \
+            _validation_predictor.cfg is not model_cfg:
+        _validation_predictor = StereoPredictor(
+            model_cfg, variables, valid_iters=cfg.valid_iters)
+    else:
+        _validation_predictor.variables = variables
+    return _validation_predictor
+
+
+def _maybe_validate_things(predictor, cfg: TrainConfig) -> Dict[str, float]:
+    """validate-on-Things hook (train_stereo.py:188); skipped when the
+    FlyingThings TEST data is not on disk."""
+    import os.path as osp
+    if not osp.isdir(osp.join(cfg.data_root, "FlyingThings3D")):
+        logger.info("FlyingThings3D not found under %s; skipping validation",
+                    cfg.data_root)
+        return {}
+    from raft_stereo_tpu.eval.validate import validate_things
+    try:
+        return validate_things(predictor, root=cfg.data_root,
+                               iters=cfg.valid_iters)
+    except ValueError as e:  # e.g. TEST split not downloaded
+        logger.info("skipping validation: %s", e)
+        return {}

@@ -1,0 +1,6 @@
+from raft_stereo_tpu.utils.checkpoint_convert import (
+    convert_state_dict,
+    load_reference_checkpoint,
+)
+
+__all__ = ["convert_state_dict", "load_reference_checkpoint"]

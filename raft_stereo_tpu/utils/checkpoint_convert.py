@@ -1,0 +1,186 @@
+"""Convert reference PyTorch checkpoints (.pth state_dicts) to flax variables.
+
+The reference distributes weights-only state dicts saved from an
+``nn.DataParallel`` wrapper, so every key carries a ``module.`` prefix
+(train_stereo.py:184-186; evaluate_stereo.py:216-221). This module renames
+those keys onto the framework's flax tree (NHWC) and:
+
+* transposes conv weights ``(O, I, kH, kW) -> (kH, kW, I, O)``,
+* maps BatchNorm running statistics into the non-trainable ``batch_stats``
+  collection — the reference always runs BN in eval mode (``freeze_bn``,
+  train_stereo.py:151), so the running stats are constants here by design,
+* drops torch bookkeeping (``num_batches_tracked``).
+
+Name map (torch -> flax), derived from core/raft_stereo.py:29-39,
+core/extractor.py:122-300, core/update.py:97-113:
+
+    cnet.conv1 / norm1 / layer{1-3}.{j}   -> cnet.trunk.{conv1,norm1,layer{L}_{j}}
+    cnet.layer{4,5}.{j}                   -> cnet.layer{L}_{j}
+    cnet.outputs{08,16}.{i}.{0,1}         -> cnet.outputs{08,16}_{i}_{res,conv}
+    cnet.outputs32.{i}                    -> cnet.outputs32_{i}_conv
+    fnet.conv1 / norm1 / layer{1-3}.{j}   -> fnet.trunk....   ;  fnet.conv2 -> fnet.conv2
+    conv2.{0,1}        (shared backbone)  -> conv2_res / conv2_out
+    context_zqr_convs.{i}                 -> context_zqr_convs_{i}
+    update_block.{encoder,gru08/16/32,flow_head} -> refinement.update_block.(same)
+    update_block.mask.{0,2}               -> refinement.update_block.mask_conv{1,2}
+    ResidualBlock: downsample.0 -> down_conv; downsample.1 == norm3 (duplicate
+    registration in the reference, extractor.py:44-45) -> norm3
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def _residual_part(parts):
+    """Map ResidualBlock-internal torch names to flax names."""
+    if parts[0] == "downsample":
+        return ("down_conv",) if parts[1] == "0" else ("norm3",)
+    return (parts[0],)
+
+
+def _encoder_path(parts) -> Tuple[str, ...]:
+    """Path inside BasicEncoder/MultiBasicEncoder (after ``cnet.``/``fnet.``)."""
+    head = parts[0]
+    if head in ("conv1", "norm1"):
+        return ("trunk", head)
+    if head == "conv2":  # fnet 1x1 output conv (extractor.py:149)
+        return ("conv2",)
+    m = re.fullmatch(r"layer([1-5])", head)
+    if m:
+        lvl = int(m.group(1))
+        block = f"layer{lvl}_{parts[1]}"
+        rest = _residual_part(parts[2:])
+        return (("trunk", block) if lvl <= 3 else (block,)) + rest
+    m = re.fullmatch(r"outputs(08|16|32)", head)
+    if m:
+        scale, i = m.group(1), parts[1]
+        if scale == "32":  # bare conv head (extractor.py:245-250)
+            return (f"outputs32_{i}_conv",)
+        if parts[2] == "0":
+            return (f"outputs{scale}_{i}_res",) + _residual_part(parts[3:])
+        return (f"outputs{scale}_{i}_conv",)
+    raise KeyError(f"unrecognized encoder sub-path {'.'.join(parts)}")
+
+
+def _module_path(parts) -> Tuple[str, ...]:
+    head = parts[0]
+    if head in ("cnet", "fnet"):
+        return (head,) + _encoder_path(parts[1:])
+    if head == "conv2":  # shared-backbone feature head (raft_stereo.py:34-37)
+        if parts[1] == "0":
+            return ("conv2_res",) + _residual_part(parts[2:])
+        return ("conv2_out",)
+    if head == "context_zqr_convs":
+        return (f"context_zqr_convs_{parts[1]}",)
+    if head == "update_block":
+        sub = parts[1]
+        if sub == "mask":
+            return ("refinement", "update_block",
+                    "mask_conv1" if parts[2] == "0" else "mask_conv2")
+        return ("refinement", "update_block", sub) + tuple(parts[2:])
+    raise KeyError(f"unrecognized top-level module {head!r}")
+
+
+def _set(tree: Dict, path: Tuple[str, ...], value) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def convert_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Dict]:
+    """Torch state_dict -> ``{"params": ..., "batch_stats": ...}`` pytree.
+
+    Accepts tensors or numpy arrays; returns numpy fp32 leaves. Keys may or
+    may not carry the DataParallel ``module.`` prefix.
+    """
+    params: Dict = {}
+    batch_stats: Dict = {}
+    for key, val in state_dict.items():
+        if hasattr(val, "detach"):  # torch tensor
+            val = val.detach().cpu().numpy()
+        arr = np.asarray(val, dtype=np.float32)
+        parts = key.split(".")
+        if parts[0] == "module":
+            parts = parts[1:]
+        leaf = parts[-1]
+        if leaf == "num_batches_tracked":
+            continue
+        path = _module_path(parts[:-1])
+        if leaf == "running_mean":
+            _set(batch_stats, path + ("mean",), arr)
+        elif leaf == "running_var":
+            _set(batch_stats, path + ("var",), arr)
+        elif leaf == "weight":
+            if arr.ndim == 4:  # conv: (O, I, kH, kW) -> (kH, kW, I, O)
+                _set(params, path + ("kernel",), arr.transpose(2, 3, 1, 0))
+            else:  # norm affine weight
+                _set(params, path + ("scale",), arr)
+        elif leaf == "bias":
+            _set(params, path + ("bias",), arr)
+        else:
+            raise KeyError(f"unrecognized leaf {leaf!r} in {key!r}")
+    return {"params": params, "batch_stats": batch_stats}
+
+
+def load_reference_checkpoint(path: str) -> Dict[str, Dict]:
+    """Load a reference ``.pth`` / ``.pth.gz`` checkpoint and convert it.
+
+    ``.pth.gz`` is the reference's per-epoch save format (train_stereo.py:201-204).
+    """
+    import torch
+
+    if path.endswith(".gz"):
+        import gzip
+        with gzip.open(path, "rb") as f:
+            state = torch.load(f, map_location="cpu")
+    else:
+        state = torch.load(path, map_location="cpu")
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]
+    return convert_state_dict(state)
+
+
+def validate_against_variables(converted: Dict, variables: Dict, *,
+                               allow_unused: bool = True) -> Dict[str, Dict]:
+    """Check the converted tree against a model init; return the usable tree.
+
+    The flax-side analog of the reference's ``load_state_dict(strict=True)``
+    (train_stereo.py:146): missing keys and shape mismatches always raise.
+    ``allow_unused`` prunes checkpoint tensors the flax model has no slot for —
+    the torch reference instantiates modules it never runs (e.g. ``layer5``/
+    ``outputs32`` when ``n_gru_layers < 3``, extractor.py:224-250), so their
+    weights are genuinely dead and safe to drop.
+    """
+    import jax
+
+    def _unflatten(d: Dict[str, Any]) -> Dict:
+        tree: Dict = {}
+        for key, v in d.items():
+            _set(tree, tuple(key), v)
+        return tree
+
+    out: Dict[str, Dict] = {}
+    for col in ("params", "batch_stats"):
+        got = jax.tree_util.tree_flatten_with_path(converted.get(col, {}))[0]
+        want = jax.tree_util.tree_flatten_with_path(variables.get(col, {}))[0]
+        got_d = {tuple(k.key for k in p): v for p, v in got}
+        want_d = {tuple(k.key for k in p): v.shape for p, v in want}
+        missing = sorted(set(want_d) - set(got_d))
+        unexpected = sorted(set(got_d) - set(want_d))
+        bad_shape = sorted(k for k in set(got_d) & set(want_d)
+                           if got_d[k].shape != want_d[k])
+        if missing or bad_shape or (unexpected and not allow_unused):
+            raise ValueError(
+                f"checkpoint/{col} mismatch:\n"
+                f"  missing: {missing[:8]}{'...' if len(missing) > 8 else ''}\n"
+                f"  unexpected: {unexpected[:8]}"
+                f"{'...' if len(unexpected) > 8 else ''}\n"
+                f"  shape mismatch: {bad_shape[:8]}"
+                f"{'...' if len(bad_shape) > 8 else ''}")
+        out[col] = _unflatten({k: v for k, v in got_d.items() if k in want_d})
+    return out

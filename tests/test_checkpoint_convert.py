@@ -1,0 +1,121 @@
+"""Checkpoint converter: torch state_dict -> flax variables, end-to-end parity.
+
+The reference ships no weights in-repo, so the oracle is a *randomly
+initialized* reference model: build core/raft_stereo.py's RAFTStereo, convert
+its ``state_dict()``, and require the flax forward to match the torch forward
+on the same images. This is the strictest possible converter test — every
+renamed tensor, layout transpose, and BN-stat mapping must be right or the
+iterative refinement diverges.
+"""
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.utils import convert_state_dict
+from raft_stereo_tpu.utils.checkpoint_convert import validate_against_variables
+
+from conftest import requires_reference
+
+
+def _torch_reference_model(cfg: RAFTStereoConfig, seed: int = 7):
+    import argparse
+    import torch
+
+    from core.raft_stereo import RAFTStereo as TorchRAFTStereo
+
+    args = argparse.Namespace(
+        hidden_dims=list(cfg.hidden_dims),
+        corr_implementation="reg",
+        shared_backbone=cfg.shared_backbone,
+        corr_levels=cfg.corr_levels,
+        corr_radius=cfg.corr_radius,
+        n_downsample=cfg.n_downsample,
+        context_norm=cfg.context_norm,
+        slow_fast_gru=cfg.slow_fast_gru,
+        n_gru_layers=cfg.n_gru_layers,
+        mixed_precision=False,
+    )
+    torch.manual_seed(seed)
+    model = TorchRAFTStereo(args)
+    model.eval()
+    return model
+
+
+@requires_reference
+@pytest.mark.parametrize("cfg", [
+    RAFTStereoConfig(),
+    RAFTStereoConfig(context_norm="instance"),   # iRaftStereo_RVC preset
+], ids=["default", "rvc-instance"])
+def test_converted_forward_matches_torch(torch_reference, cfg):
+    import torch
+
+    tmodel = _torch_reference_model(cfg)
+    converted = convert_state_dict(tmodel.state_dict())
+
+    model, variables = init_model(
+        __import__("jax").random.PRNGKey(0), cfg, (1, 64, 96, 3))
+    converted = validate_against_variables(converted, variables)
+
+    rng = np.random.default_rng(3)
+    img1 = rng.uniform(0, 255, (1, 48, 96, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, (1, 48, 96, 3)).astype(np.float32)
+
+    with torch.no_grad():
+        t1 = torch.from_numpy(img1.transpose(0, 3, 1, 2))
+        t2 = torch.from_numpy(img2.transpose(0, 3, 1, 2))
+        t_low, t_up = tmodel(t1, t2, iters=5, test_mode=True)
+
+    j_low, j_up = model.apply(converted, img1, img2, iters=5, test_mode=True)
+
+    t_up_np = t_up.numpy().transpose(0, 2, 3, 1)      # NCHW -> NHWC
+    t_low_np = t_low.numpy().transpose(0, 2, 3, 1)
+
+    np.testing.assert_allclose(np.asarray(j_low), t_low_np, atol=2e-3,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(j_up), t_up_np, atol=5e-3, rtol=1e-4)
+
+
+@requires_reference
+def test_shared_backbone_conversion(torch_reference):
+    """The realtime preset's shared-backbone path converts and validates."""
+    import jax
+
+    cfg = RAFTStereoConfig(shared_backbone=True, n_downsample=3,
+                           n_gru_layers=2, slow_fast_gru=True)
+    tmodel = _torch_reference_model(cfg)
+    converted = convert_state_dict(tmodel.state_dict())
+    # width >= 128: at 1/8 resolution the corr pyramid needs W2 divisible
+    # through num_levels poolings (the torch oracle hard-fails below that)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 128, 3))
+    # the torch model instantiates layer5/outputs32 even with n_gru_layers=2;
+    # those weights are dead and pruned here
+    converted = validate_against_variables(converted, variables)
+
+    import torch
+    rng = np.random.default_rng(5)
+    img1 = rng.uniform(0, 255, (1, 64, 128, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, (1, 64, 128, 3)).astype(np.float32)
+    with torch.no_grad():
+        t_low, t_up = tmodel(
+            torch.from_numpy(img1.transpose(0, 3, 1, 2)),
+            torch.from_numpy(img2.transpose(0, 3, 1, 2)),
+            iters=4, test_mode=True)
+    j_low, j_up = model.apply(converted, img1, img2, iters=4, test_mode=True)
+    np.testing.assert_allclose(
+        np.asarray(j_up), t_up.numpy().transpose(0, 2, 3, 1),
+        atol=5e-3, rtol=1e-4)
+
+
+@requires_reference
+def test_strict_validation_catches_mismatch(torch_reference):
+    import jax
+
+    cfg = RAFTStereoConfig()
+    tmodel = _torch_reference_model(cfg)
+    converted = convert_state_dict(tmodel.state_dict())
+    del converted["params"]["fnet"]["conv2"]
+    _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 96, 3))
+    with pytest.raises(ValueError, match="missing"):
+        validate_against_variables(converted, variables)

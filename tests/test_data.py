@@ -262,3 +262,26 @@ def test_loader_epochs_differ(tmp_path):
     e0 = next(iter(loader))
     e1 = next(iter(loader))
     assert not np.array_equal(e0["image1"], e1["image1"])
+
+
+def test_sparse_flip_keeps_valid_aligned():
+    """'v' flip must move the sparse valid mask together with the flow (a fix
+    over the reference, which leaves valid unflipped)."""
+    from raft_stereo_tpu.data.augment import SparseFlowAugmentor
+
+    aug = SparseFlowAugmentor(crop_size=(32, 48), do_flip="v")
+    aug.spatial_aug_prob = -1.0  # disable resize
+    aug.v_flip_prob = 1.1        # force the flip
+    rng = np.random.default_rng(0)
+    h, w = 40, 56
+    img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    flow = np.zeros((h, w, 2), np.float32)
+    valid = np.zeros((h, w), np.float32)
+    flow[5, 7] = (-3.0, 0.0)
+    valid[5, 7] = 1.0
+    _, _, flow_a, valid_a = aug(img, img, flow, valid, rng)
+    # wherever valid survived the crop, flow must carry the flipped value
+    ys, xs = np.nonzero(valid_a)
+    for y, x in zip(ys, xs):
+        assert flow_a[y, x, 0] == -3.0
+        assert flow_a[y, x, 1] == 0.0

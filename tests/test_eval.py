@@ -1,0 +1,105 @@
+"""Evaluation layer: StereoPredictor bucketing + validators on synthetic data."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.data import frame_utils
+from raft_stereo_tpu.eval import validate_eth3d, validate_middlebury
+from raft_stereo_tpu.inference import StereoPredictor, bucket_size
+from raft_stereo_tpu.models import init_model
+
+
+@pytest.fixture(scope="module")
+def predictor():
+    cfg = RAFTStereoConfig()
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 96, 3))
+    return StereoPredictor(cfg, variables, valid_iters=2)
+
+
+def test_bucket_size():
+    assert bucket_size(41, 32) == 64
+    assert bucket_size(64, 32) == 64
+    assert bucket_size(65, 32, bucket=128) == 128
+    assert bucket_size(129, 32, bucket=128) == 256
+
+
+def test_predictor_shapes_and_caching(predictor):
+    rng = np.random.default_rng(0)
+    out = predictor(rng.uniform(0, 255, (1, 47, 90, 3)),
+                    rng.uniform(0, 255, (1, 47, 90, 3)))
+    assert out.shape == (1, 47, 90, 1)
+    assert np.isfinite(out).all()
+    assert len(predictor._compiled) == 1
+    # 40x88 pads to the same 64x96 -> no new compile
+    predictor(rng.uniform(0, 255, (1, 40, 88, 3)),
+              rng.uniform(0, 255, (1, 40, 88, 3)))
+    assert len(predictor._compiled) == 1
+    # a genuinely different padded shape -> new entry
+    predictor(rng.uniform(0, 255, (1, 100, 120, 3)),
+              rng.uniform(0, 255, (1, 100, 120, 3)))
+    assert len(predictor._compiled) == 2
+
+    bucketed = StereoPredictor(predictor.cfg, predictor.variables,
+                               valid_iters=2, bucket=128)
+    bucketed(rng.uniform(0, 255, (1, 47, 90, 3)),
+             rng.uniform(0, 255, (1, 47, 90, 3)))
+    bucketed(rng.uniform(0, 255, (1, 100, 120, 3)),
+             rng.uniform(0, 255, (1, 100, 120, 3)))
+    assert len(bucketed._compiled) == 1  # both land in the 128x128 bucket
+
+
+def test_compute_disparity_sign_and_grayscale(predictor):
+    rng = np.random.default_rng(1)
+    left = rng.uniform(0, 255, (47, 90)).astype(np.uint8)  # grayscale path
+    disp = predictor.compute_disparity(left, left)
+    assert disp.shape == (47, 90)
+    assert np.isfinite(disp).all()
+
+
+def _write_eth3d_tree(root, n=2, h=48, w=96):
+    rng = np.random.default_rng(7)
+    for i in range(n):
+        scene = root / "ETH3D" / "two_view_training" / f"scene_{i}"
+        gt = root / "ETH3D" / "two_view_training_gt" / f"scene_{i}"
+        scene.mkdir(parents=True)
+        gt.mkdir(parents=True)
+        for name in ("im0.png", "im1.png"):
+            Image.fromarray(rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                            ).save(scene / name)
+        frame_utils.write_pfm(str(gt / "disp0GT.pfm"),
+                              rng.uniform(0, 8, (h, w)).astype(np.float32))
+        Image.fromarray((rng.uniform(size=(h, w)) > 0.2).astype(np.uint8)
+                        * 255).save(gt / "mask0nocc.png")
+
+
+def test_validate_eth3d_synthetic(tmp_path, predictor):
+    _write_eth3d_tree(tmp_path)
+    result = validate_eth3d(predictor, root=str(tmp_path), iters=2)
+    assert set(result) == {"eth3d-epe", "eth3d-d1"}
+    assert np.isfinite(result["eth3d-epe"])
+    assert 0.0 <= result["eth3d-d1"] <= 100.0
+
+
+def _write_middlebury_tree(root, h=48, w=96):
+    rng = np.random.default_rng(9)
+    base = root / "Middlebury" / "MiddEval3"
+    (base / "trainingF" / "SceneA").mkdir(parents=True)
+    (base / "official_train.txt").write_text("SceneA\n")
+    scene = base / "trainingF" / "SceneA"
+    for name in ("im0.png", "im1.png"):
+        Image.fromarray(rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                        ).save(scene / name)
+    frame_utils.write_pfm(str(scene / "disp0GT.pfm"),
+                          rng.uniform(0, 8, (h, w)).astype(np.float32))
+    Image.fromarray(np.full((h, w), 255, np.uint8)).save(scene / "mask0nocc.png")
+
+
+def test_validate_middlebury_synthetic(tmp_path, predictor):
+    _write_middlebury_tree(tmp_path)
+    result = validate_middlebury(predictor, root=str(tmp_path), iters=2)
+    assert set(result) == {"middleburyF-epe", "middleburyF-d1"}
+    assert np.isfinite(result["middleburyF-epe"])

@@ -1,0 +1,90 @@
+"""End-to-end training loop: synthetic data -> train() -> checkpoint -> resume.
+
+The reference's quality gate is validation-as-integration-test (SURVEY §4);
+here the integration test is automated: a tiny synthetic SceneFlow tree, a few
+optimizer steps on the 8-device CPU mesh, full-state checkpointing, and an
+exact-resume check (which the reference cannot do — it restarts schedules).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.data import frame_utils
+from raft_stereo_tpu.training.checkpoint import (restore_train_state,
+                                                 save_train_state)
+from raft_stereo_tpu.training.logger import SUM_FREQ, Logger
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState
+from raft_stereo_tpu.training.trainer import train
+
+
+def _make_sceneflow_tree(root, n=4, h=64, w=96):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for dstype in ("frames_cleanpass", "frames_finalpass"):
+        for side in ("left", "right"):
+            (root / "FlyingThings3D" / dstype / "TRAIN" / "A" / "0000" / side
+             ).mkdir(parents=True, exist_ok=True)
+        (root / "FlyingThings3D" / "disparity" / "TRAIN" / "A" / "0000" /
+         "left").mkdir(parents=True, exist_ok=True)
+        for i in range(n):
+            for side in ("left", "right"):
+                img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+                Image.fromarray(img).save(
+                    root / "FlyingThings3D" / dstype / "TRAIN" / "A" / "0000" /
+                    side / f"{i:04d}.png")
+            frame_utils.write_pfm(
+                str(root / "FlyingThings3D" / "disparity" / "TRAIN" / "A" /
+                    "0000" / "left" / f"{i:04d}.pfm"),
+                rng.uniform(0.5, 8, (h, w)).astype(np.float32))
+
+
+@pytest.mark.slow
+def test_train_loop_end_to_end(tmp_path):
+    _make_sceneflow_tree(tmp_path)
+    model_cfg = RAFTStereoConfig()
+    cfg = TrainConfig(
+        name="tiny", batch_size=2, num_steps=3, image_size=(48, 64),
+        train_iters=2, valid_iters=2, data_root=str(tmp_path),
+        ckpt_dir=str(tmp_path / "ckpts"), validation_frequency=2,
+        num_workers=2, data_parallel=2, seq_parallel=1, lr=1e-4)
+    final = train(model_cfg, cfg)
+    assert os.path.isdir(final)
+
+    # resume restores the exact step counter
+    model_cfg2 = RAFTStereoConfig()
+    from raft_stereo_tpu.models import init_model
+    _, variables = init_model(jax.random.PRNGKey(0), model_cfg2, (1, 48, 64, 3))
+    state = TrainState.create(variables, fetch_optimizer(cfg))
+    restored = restore_train_state(final, jax.device_get(state))
+    assert int(restored.step) == 3
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from raft_stereo_tpu.models import init_model
+    cfg = TrainConfig(num_steps=10)
+    _, variables = init_model(jax.random.PRNGKey(1), RAFTStereoConfig(),
+                              (1, 32, 64, 3))
+    state = TrainState.create(variables, fetch_optimizer(cfg))
+    path = save_train_state(str(tmp_path), "t", state, step=5)
+    assert path.endswith("5_t")
+    restored = restore_train_state(path, jax.device_get(state))
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["fnet"]["conv2"]["kernel"]),
+        np.asarray(state.params["fnet"]["conv2"]["kernel"]))
+
+
+def test_logger_windows(tmp_path, caplog):
+    import logging
+    log = Logger(log_dir=str(tmp_path / "runs"))
+    with caplog.at_level(logging.INFO,
+                         logger="raft_stereo_tpu.training.logger"):
+        for i in range(SUM_FREQ):
+            log.push({"loss": 2.0, "epe": 1.0}, lr=1e-4)
+    assert any("loss" in r.message for r in caplog.records)
+    log.close()
