@@ -24,7 +24,7 @@ from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
 from raft_stereo_tpu.nn.gru import BasicMultiUpdateBlock
 from raft_stereo_tpu.nn.layers import Conv, ResidualBlock
 from raft_stereo_tpu.ops.corr import CorrState, corr_lookup, init_corr
-from raft_stereo_tpu.ops.geometry import coords_grid, upsample_flow_convex
+from raft_stereo_tpu.ops.geometry import coords_grid, upsample_disparity_convex
 
 Dtype = Any
 
@@ -42,7 +42,8 @@ class RefinementStep(nn.Module):
     dtype: Optional[Dtype] = None
 
     @nn.compact
-    def __call__(self, carry, corr_state: CorrState, inp_list, coords0):
+    def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
+                 gt_and_mask):
         net, coords1, _ = carry
         coords1 = jax.lax.stop_gradient(coords1)
 
@@ -71,9 +72,19 @@ class RefinementStep(nn.Module):
         if self.test_mode:
             # intermediate upsampling skipped (raft_stereo.py:126-127)
             return new_carry, None
-        flow_up = upsample_flow_convex(coords1 - coords0,
-                                       mask.astype(jnp.float32), cfg.factor)
-        return new_carry, flow_up[..., :1]
+        flow_up = upsample_disparity_convex(coords1 - coords0,
+                                            mask.astype(jnp.float32),
+                                            cfg.factor)
+        if gt_and_mask is not None:
+            # fused-loss path: reduce this iteration's masked L1 to a scalar
+            # INSIDE the scan, so the (iters, B, H, W, 1) full-resolution
+            # prediction stack (~0.7 GB at train shape) is never written to
+            # HBM nor re-read in the backward pass.
+            flow_gt, loss_mask = gt_and_mask
+            err = jnp.abs(flow_up.astype(jnp.float32) - flow_gt)
+            err_sum = jnp.sum(jnp.where(loss_mask > 0, err, 0.0))
+            return new_carry, err_sum
+        return new_carry, flow_up
 
 
 class RAFTStereo(nn.Module):
@@ -98,7 +109,11 @@ class RAFTStereo(nn.Module):
 
     @nn.compact
     def __call__(self, image1, image2, iters: int = 12, flow_init=None,
-                 test_mode: bool = False):
+                 test_mode: bool = False, flow_gt=None, loss_mask=None):
+        """``flow_gt``/``loss_mask`` (both ``(B, H, W, 1)``) switch on the
+        fused-loss training path: returns ``(per_iter_err_sums (iters,),
+        final flow_up (B, H, W, 1))`` instead of the stacked predictions —
+        same math as sequence_loss over the stack, far less HBM traffic."""
         cfg = self.cfg
         dt = self.compute_dtype
 
@@ -162,17 +177,26 @@ class RAFTStereo(nn.Module):
             body,
             variable_broadcast="params",
             split_rngs={"params": False},
-            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
         )(cfg, test_mode, dt, name="refinement")
+        gt_and_mask = None
+        if flow_gt is not None:
+            gt_and_mask = (flow_gt.astype(jnp.float32),
+                           loss_mask.astype(jnp.float32))
         carry, flow_predictions = step(carry, corr_state, tuple(inp_list),
-                                       coords0)
+                                       coords0, gt_and_mask)
         net_list, coords1, mask = carry
 
         if test_mode:
-            flow_up = upsample_flow_convex(coords1 - coords0, mask, cfg.factor)
-            return coords1 - coords0, flow_up[..., :1]
+            flow_up = upsample_disparity_convex(coords1 - coords0, mask,
+                                                cfg.factor)
+            return coords1 - coords0, flow_up
+        if gt_and_mask is not None:
+            flow_up = upsample_disparity_convex(coords1 - coords0, mask,
+                                                cfg.factor)
+            return flow_predictions, flow_up
         return flow_predictions
 
 

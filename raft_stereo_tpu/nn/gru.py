@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -19,6 +20,26 @@ from raft_stereo_tpu.nn.layers import Conv
 from raft_stereo_tpu.ops.geometry import pool2x, resize_bilinear_align_corners
 
 Dtype = Any
+
+
+class _ConvParams(nn.Module):
+    """Declares a conv's ``kernel``/``bias`` params without running the conv,
+    so sibling convs over the same input can be fused into one MXU matmul
+    while the parameter tree keeps the reference's 1:1 layout."""
+
+    kernel: Tuple[int, int]
+    in_features: int
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        from raft_stereo_tpu.nn.layers import kaiming_normal_init
+        k = self.param("kernel", kaiming_normal_init(),
+                       (*self.kernel, self.in_features, self.features),
+                       jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (self.features,),
+                       jnp.float32)
+        return k, b
 
 
 class FlowHead(nn.Module):
@@ -35,7 +56,14 @@ class FlowHead(nn.Module):
 
 
 class ConvGRU(nn.Module):
-    """Convolutional GRU with additive per-gate context biases (update.py:16-32)."""
+    """Convolutional GRU with additive per-gate context biases (update.py:16-32).
+
+    TPU note: the z and r gates share the same input ``hx``, so their convs
+    run as ONE conv with the kernels concatenated along the output axis — a
+    single larger MXU matmul instead of two half-size ones. The parameters
+    stay separate (``convz``/``convr``) so checkpoints map 1:1 to the
+    reference's tensors.
+    """
 
     hidden_dim: int
     kernel_size: int = 3
@@ -46,10 +74,19 @@ class ConvGRU(nn.Module):
         k, p = self.kernel_size, self.kernel_size // 2
         x = jnp.concatenate(x_list, axis=-1)
         hx = jnp.concatenate([h, x], axis=-1)
-        z = nn.sigmoid(Conv.make(self.hidden_dim, k, 1, p, self.dtype,
-                                 "convz")(hx) + cz)
-        r = nn.sigmoid(Conv.make(self.hidden_dim, k, 1, p, self.dtype,
-                                 "convr")(hx) + cr)
+        in_ch = hx.shape[-1]
+
+        kz, bz = _ConvParams((k, k), in_ch, self.hidden_dim, name="convz")()
+        kr, br = _ConvParams((k, k), in_ch, self.hidden_dim, name="convr")()
+        dt = self.dtype or hx.dtype
+        kernel = jnp.concatenate([kz, kr], axis=-1).astype(dt)
+        bias = jnp.concatenate([bz, br]).astype(dt)
+        zr = jax.lax.conv_general_dilated(
+            hx.astype(dt), kernel, (1, 1), ((p, p), (p, p)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+        z, r = jnp.split(zr, 2, axis=-1)
+        z = nn.sigmoid(z + cz)
+        r = nn.sigmoid(r + cr)
         q = nn.tanh(Conv.make(self.hidden_dim, k, 1, p, self.dtype, "convq")(
             jnp.concatenate([r * h, x], axis=-1)) + cq)
         return (1 - z) * h + z * q

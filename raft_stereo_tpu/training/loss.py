@@ -37,28 +37,8 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
       ``(loss, metrics)`` with metrics ``epe``, ``1px``, ``3px``, ``5px``
       matching train_stereo.py:62-67.
     """
-    n_predictions = flow_preds.shape[0]
-    if valid.ndim == flow_gt.ndim - 1:
-        valid = valid[..., None]
-
-    mag = jnp.sqrt(jnp.sum(flow_gt.astype(jnp.float32) ** 2, axis=-1,
-                           keepdims=True))
-    mask = ((valid >= 0.5) & (mag < max_flow)).astype(jnp.float32)
-
-    def global_sum(x):
-        s = jnp.sum(x)
-        if axis_name is not None:
-            s = jax.lax.psum(s, axis_name)
-        return s
-
-    denom = jnp.maximum(global_sum(mask), 1.0)
-
-    if n_predictions > 1:
-        adjusted_gamma = loss_gamma ** (15.0 / (n_predictions - 1))
-    else:
-        adjusted_gamma = 1.0
-    weights = adjusted_gamma ** jnp.arange(n_predictions - 1, -1, -1,
-                                           dtype=jnp.float32)
+    mask = loss_mask(flow_gt, valid, max_flow)
+    global_sum = _make_global_sum(axis_name)
 
     # Guard masked-out pixels BEFORE multiplying by the mask: a non-finite GT
     # value (e.g. inf disparity from zero depth) would otherwise poison the
@@ -69,17 +49,75 @@ def sequence_loss(flow_preds: jax.Array, flow_gt: jax.Array, valid: jax.Array,
     per_iter = jnp.sum(abs_err, axis=(1, 2, 3, 4))
     if axis_name is not None:
         per_iter = jax.lax.psum(per_iter, axis_name)
-    flow_loss = jnp.sum(weights * per_iter) / denom
 
+    flow_loss = _weighted_loss(per_iter, mask, loss_gamma, global_sum)
+    metrics = _final_metrics(flow_preds[-1], flow_gt, mask, global_sum)
+    return flow_loss, metrics
+
+
+def loss_mask(flow_gt: jax.Array, valid: jax.Array,
+              max_flow: float = 700.0) -> jax.Array:
+    """The sequence-loss validity mask (train_stereo.py:43-46), shared by the
+    stacked and fused paths: valid pixels with |gt flow| < max_flow."""
+    if valid.ndim == flow_gt.ndim - 1:
+        valid = valid[..., None]
+    mag = jnp.sqrt(jnp.sum(flow_gt.astype(jnp.float32) ** 2, axis=-1,
+                           keepdims=True))
+    return ((valid >= 0.5) & (mag < max_flow)).astype(jnp.float32)
+
+
+def _make_global_sum(axis_name: Optional[str]):
+    def global_sum(x):
+        s = jnp.sum(x)
+        if axis_name is not None:
+            s = jax.lax.psum(s, axis_name)
+        return s
+    return global_sum
+
+
+def _weighted_loss(per_iter_sums, mask, loss_gamma, global_sum):
+    """Exponential weighting + valid-pixel normalization (train_stereo.py:50-57).
+
+    ``per_iter_sums``: (iters,) masked L1 sums, already globally reduced by
+    the caller when running under a mesh axis.
+    """
+    n = per_iter_sums.shape[0]
+    adjusted_gamma = loss_gamma ** (15.0 / (n - 1)) if n > 1 else 1.0
+    weights = adjusted_gamma ** jnp.arange(n - 1, -1, -1, dtype=jnp.float32)
+    denom = jnp.maximum(global_sum(mask), 1.0)
+    return jnp.sum(weights * per_iter_sums) / denom
+
+
+def _final_metrics(final_flow, flow_gt, mask, global_sum):
     epe = jnp.sqrt(jnp.sum(
-        (flow_preds[-1].astype(jnp.float32) - flow_gt) ** 2, axis=-1))
+        (final_flow.astype(jnp.float32) - flow_gt) ** 2, axis=-1))
     m = mask[..., 0]
     epe = jnp.where(m > 0, epe, 0.0)
-    epe_sum = global_sum(epe)
-    metrics = {
-        "epe": epe_sum / denom,
+    denom = jnp.maximum(global_sum(mask), 1.0)
+    return {
+        "epe": global_sum(epe) / denom,
         "1px": global_sum((epe < 1.0) * m) / denom,
         "3px": global_sum((epe < 3.0) * m) / denom,
         "5px": global_sum((epe < 5.0) * m) / denom,
     }
+
+
+def sequence_loss_fused(per_iter_err_sums: jax.Array, final_flow: jax.Array,
+                        flow_gt: jax.Array, mask: jax.Array,
+                        loss_gamma: float = 0.9,
+                        axis_name: Optional[str] = None,
+                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Sequence loss from in-scan reduced error sums (the fused-loss path).
+
+    Identical math to :func:`sequence_loss`: the model already reduced each
+    iteration's masked L1 to a scalar inside its scan (models/raft_stereo.py),
+    so only the exponential weighting, normalization, and final-iteration
+    metrics remain.
+    """
+    global_sum = _make_global_sum(axis_name)
+    per_iter = per_iter_err_sums
+    if axis_name is not None:
+        per_iter = jax.lax.psum(per_iter, axis_name)
+    flow_loss = _weighted_loss(per_iter, mask, loss_gamma, global_sum)
+    metrics = _final_metrics(final_flow, flow_gt, mask, global_sum)
     return flow_loss, metrics

@@ -91,6 +91,7 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         step_fn = make_pjit_train_step(model, tx, cfg.train_iters, mesh)
 
         log = Logger(total_steps=int(state.step))
+        validation_predictor = None  # built lazily, reused across validations
         t_start, imgs_done = time.perf_counter(), 0
         for batch in infinite_batches(loader):
             global_step = int(state.step)
@@ -108,8 +109,15 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                 ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
                                         step=global_step)
                 logger.info("saved %s", ckpt)
-                predictor = _get_validation_predictor(model_cfg, state, cfg)
-                results = _maybe_validate_things(predictor, cfg)
+                variables_host = jax.device_get(state.variables)
+                if validation_predictor is None:
+                    from raft_stereo_tpu.inference import StereoPredictor
+                    validation_predictor = StereoPredictor(
+                        model_cfg, variables_host,
+                        valid_iters=cfg.valid_iters)
+                else:  # keep the jit cache, refresh only the weights
+                    validation_predictor.variables = variables_host
+                results = _maybe_validate_things(validation_predictor, cfg)
                 if results:
                     log.write_dict(results)
                 dt = time.perf_counter() - t_start
@@ -121,25 +129,6 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         log.close()
     logger.info("training done: %s", final)
     return final
-
-
-_validation_predictor = None
-
-
-def _get_validation_predictor(model_cfg: RAFTStereoConfig, state: TrainState,
-                              cfg: TrainConfig):
-    """One predictor per run, its jit cache reused across validation passes;
-    only the weights are refreshed each time."""
-    global _validation_predictor
-    from raft_stereo_tpu.inference import StereoPredictor
-    variables = jax.device_get(state.variables)
-    if _validation_predictor is None or \
-            _validation_predictor.cfg is not model_cfg:
-        _validation_predictor = StereoPredictor(
-            model_cfg, variables, valid_iters=cfg.valid_iters)
-    else:
-        _validation_predictor.variables = variables
-    return _validation_predictor
 
 
 def _maybe_validate_things(predictor, cfg: TrainConfig) -> Dict[str, float]:

@@ -125,3 +125,18 @@ class TestInputPadder:
         x = jnp.zeros((1, 37, 64, 3))
         padder = InputPadder(x.shape, mode="kitti", divis_by=32)
         assert padder._pad == [0, 0, 0, 27]
+
+
+def test_upsample_disparity_matches_generic():
+    """Single-channel TPU-layout upsample == generic convex upsample, ch 0."""
+    from raft_stereo_tpu.ops.geometry import (upsample_disparity_convex,
+                                              upsample_flow_convex)
+    rng = np.random.default_rng(5)
+    for factor in (2, 4, 8):
+        flow = jnp.asarray(rng.normal(size=(2, 6, 8, 2)), jnp.float32)
+        mask = jnp.asarray(rng.normal(size=(2, 6, 8, 9 * factor * factor)),
+                           jnp.float32)
+        want = upsample_flow_convex(flow, mask, factor)[..., :1]
+        got = upsample_disparity_convex(flow, mask, factor)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)

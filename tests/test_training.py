@@ -172,3 +172,35 @@ def test_shardmap_dp_matches_single_device():
     for a, b in zip(leaves_ref, leaves_dp):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-5)
+
+
+def test_fused_loss_matches_stacked():
+    """The in-scan fused loss path must produce the same loss/metrics as
+    sequence_loss over the stacked predictions."""
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.training.loss import (loss_mask, sequence_loss,
+                                               sequence_loss_fused)
+
+    cfg = RAFTStereoConfig()
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 48, 64, 3))
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (2, 48, 64, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (2, 48, 64, 3)), jnp.float32)
+    gt = jnp.asarray(rng.uniform(-8, 0, (2, 48, 64, 1)), jnp.float32)
+    valid = jnp.asarray(rng.uniform(size=(2, 48, 64)) > 0.3, jnp.float32)
+
+    preds = model.apply(variables, img1, img2, iters=3)
+    loss_a, metrics_a = sequence_loss(preds, gt, valid)
+
+    mask = loss_mask(gt, valid)
+    err_sums, final_flow = model.apply(variables, img1, img2, iters=3,
+                                       flow_gt=gt, loss_mask=mask)
+    loss_b, metrics_b = sequence_loss_fused(err_sums, final_flow, gt, mask)
+
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for k in metrics_a:
+        np.testing.assert_allclose(float(metrics_a[k]), float(metrics_b[k]),
+                                   rtol=1e-6, err_msg=k)
