@@ -1,54 +1,11 @@
 #!/usr/bin/env python
-"""Evaluation CLI (reference evaluate_stereo.py:192-243, same flag surface)."""
+"""Evaluation CLI (reference evaluate_stereo.py:192-243, same flag surface).
 
-import argparse
-import logging
+Thin wrapper over the installable console entry point
+(``raft_stereo_tpu.cli:_eval_main`` == ``raft-stereo-eval``).
+"""
 
-from raft_stereo_tpu import cli
-from raft_stereo_tpu.eval.validate import (validate_eth3d, validate_kitti,
-                                           validate_middlebury,
-                                           validate_things)
-from raft_stereo_tpu.inference import StereoPredictor
-
-
-def main():
-    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU evaluation")
-    parser.add_argument("--restore_ckpt", default=None,
-                        help="reference .pth or orbax state dir")
-    parser.add_argument("--dataset", required=True,
-                        choices=["eth3d", "kitti", "things",
-                                 "middlebury_F", "middlebury_H",
-                                 "middlebury_Q"])
-    parser.add_argument("--valid_iters", type=int, default=32,
-                        help="number of refinement iterations")
-    parser.add_argument("--data_root", default="datasets")
-    parser.add_argument("--bucket", type=int, default=0,
-                        help="pad eval images up to multiples of this size "
-                             "to bound recompiles (0 = exact /32 padding)")
-    cli.add_model_args(parser)
-    args = parser.parse_args()
-
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
-
-    cfg = cli.model_config(args)
-    model, variables = cli.load_variables(args.restore_ckpt, cfg)
-    predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
-                                bucket=args.bucket)
-
-    if args.dataset == "eth3d":
-        results = validate_eth3d(predictor, args.data_root, args.valid_iters)
-    elif args.dataset == "kitti":
-        results = validate_kitti(predictor, args.data_root, args.valid_iters)
-    elif args.dataset == "things":
-        results = validate_things(predictor, args.data_root, args.valid_iters)
-    else:
-        split = args.dataset.split("_")[1]
-        results = validate_middlebury(predictor, args.data_root,
-                                      args.valid_iters, split=split)
-    print(results)
-
+from raft_stereo_tpu.cli import _eval_main
 
 if __name__ == "__main__":
-    main()
+    _eval_main()

@@ -142,3 +142,58 @@ def load_variables(restore_ckpt: Optional[str], cfg: RAFTStereoConfig,
     restored = restore_train_state(restore_ckpt, jax.device_get(state))
     return model, {"params": restored.params,
                    "batch_stats": restored.batch_stats}
+
+
+def _train_main():
+    """Console entry point (`raft-stereo-train`); same surface as
+    train_stereo.py."""
+    import logging
+
+    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU training")
+    add_train_args(parser)
+    add_model_args(parser)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
+    from raft_stereo_tpu.training.trainer import train
+    print(f"final checkpoint: {train(model_config(args), train_config(args))}")
+
+
+def _eval_main():
+    """Console entry point (`raft-stereo-eval`); same surface as
+    evaluate_stereo.py."""
+    import logging
+
+    from raft_stereo_tpu.eval.validate import VALIDATORS, validate_middlebury
+    from raft_stereo_tpu.inference import StereoPredictor
+
+    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU evaluation")
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="reference .pth or orbax state dir")
+    parser.add_argument("--dataset", required=True,
+                        choices=["eth3d", "kitti", "things", "middlebury_F",
+                                 "middlebury_H", "middlebury_Q"])
+    parser.add_argument("--valid_iters", type=int, default=32,
+                        help="number of refinement iterations")
+    parser.add_argument("--data_root", default="datasets")
+    parser.add_argument("--bucket", type=int, default=0,
+                        help="pad eval images up to multiples of this size "
+                             "to bound recompiles (0 = exact /32 padding)")
+    add_model_args(parser)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
+    cfg = model_config(args)
+    _, variables = load_variables(args.restore_ckpt, cfg)
+    predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
+                                bucket=args.bucket)
+    if args.dataset.startswith("middlebury_"):
+        results = validate_middlebury(predictor, args.data_root,
+                                      args.valid_iters,
+                                      split=args.dataset.split("_")[1])
+    else:
+        results = VALIDATORS[args.dataset](predictor, args.data_root,
+                                           args.valid_iters)
+    print(results)

@@ -44,6 +44,10 @@ class RAFTStereoConfig:
     # (jax.checkpoint). Without it the scan stores every iteration's conv
     # activations and SceneFlow-shape training OOMs on a 16 GB chip.
     remat_refinement: bool = True
+    # Selective-remat policy: "save_gru_convs" keeps the named GRU gate conv
+    # outputs (checkpoint_name tags in nn/gru.py) across the backward pass,
+    # trading ~2 GB of HBM for skipping their recompute. None = full remat.
+    remat_policy: Optional[str] = None
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
@@ -55,6 +59,9 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
+        if self.remat_policy not in (None, "save_gru_convs"):
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
+                             "expected None or 'save_gru_convs'")
         if len(self.hidden_dims) != 3 or self.hidden_dims[0] != self.hidden_dims[2]:
             # The reference wires context conv i (sized hidden_dims[i]) into the
             # GRU at level i whose hidden size is hidden_dims[2-i]

@@ -171,8 +171,15 @@ class RAFTStereo(nn.Module):
         # (~0.6 GB per conv buffer at the SceneFlow train shape, 22 iters) and
         # training OOMs on a 16 GB chip. Remat recomputes them from the carry
         # instead — the jax.checkpoint FLOPs-for-HBM trade.
-        body = nn.remat(RefinementStep, prevent_cse=False) if cfg.remat_refinement \
-            else RefinementStep
+        if cfg.remat_refinement:
+            remat_kwargs = {"prevent_cse": False}
+            if cfg.remat_policy == "save_gru_convs":
+                remat_kwargs["policy"] = \
+                    jax.checkpoint_policies.save_only_these_names(
+                        "gru_zr", "gru_q")
+            body = nn.remat(RefinementStep, **remat_kwargs)
+        else:
+            body = RefinementStep
         step = nn.scan(
             body,
             variable_broadcast="params",

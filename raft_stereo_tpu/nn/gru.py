@@ -14,6 +14,7 @@ from typing import Any, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.nn.layers import Conv
@@ -84,11 +85,15 @@ class ConvGRU(nn.Module):
         zr = jax.lax.conv_general_dilated(
             hx.astype(dt), kernel, (1, 1), ((p, p), (p, p)),
             dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+        # names for selective rematerialization policies (no-op otherwise)
+        zr = checkpoint_name(zr, "gru_zr")
         z, r = jnp.split(zr, 2, axis=-1)
         z = nn.sigmoid(z + cz)
         r = nn.sigmoid(r + cr)
-        q = nn.tanh(Conv.make(self.hidden_dim, k, 1, p, self.dtype, "convq")(
-            jnp.concatenate([r * h, x], axis=-1)) + cq)
+        q = checkpoint_name(
+            Conv.make(self.hidden_dim, k, 1, p, self.dtype, "convq")(
+                jnp.concatenate([r * h, x], axis=-1)), "gru_q")
+        q = nn.tanh(q + cq)
         return (1 - z) * h + z * q
 
 

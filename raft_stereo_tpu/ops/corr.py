@@ -150,6 +150,29 @@ def corr_lookup(state: CorrState, coords: jax.Array) -> jax.Array:
     return _LOOKUPS[state.impl](state, coords_x)
 
 
+def _lookup_reg_pallas(state: CorrState, coords_x: jax.Array) -> jax.Array:
+    """Fused Pallas pyramid lookup on the materialized volume (TPU kernel
+    equivalent of the reference's corr_sampler CUDA extension, SURVEY N1/N2;
+    interpreter mode on CPU)."""
+    from raft_stereo_tpu.ops.pallas.corr_kernels import windowed_sample_pallas
+    out = []
+    for i, volume in enumerate(state.levels):
+        out.append(windowed_sample_pallas(volume, coords_x / (2 ** i),
+                                          state.radius))
+    return jnp.concatenate(out, axis=-1)
+
+
+def _lookup_alt_pallas(state: CorrState, coords_x: jax.Array) -> jax.Array:
+    """Fused build+lookup: the O(W^2) slab never touches HBM (the working
+    version of the reference's absent alt_cuda_corr, core/corr.py:159-188)."""
+    from raft_stereo_tpu.ops.pallas.corr_kernels import alt_windowed_corr_pallas
+    out = []
+    for i, fmap2 in enumerate(state.levels):
+        out.append(alt_windowed_corr_pallas(state.fmap1, fmap2,
+                                            coords_x / (2 ** i), state.radius))
+    return jnp.concatenate(out, axis=-1)
+
+
 def _maybe_register_pallas() -> None:
     """Lazily register the Pallas-fused implementations.
 
@@ -168,3 +191,8 @@ def _maybe_register_pallas() -> None:
             register_corr("reg_pallas", _build_reg, _lookup_reg)
         if "alt_pallas" not in _BUILDERS:
             register_corr("alt_pallas", _build_alt, _lookup_alt)
+        return
+    if "reg_pallas" not in _BUILDERS:
+        register_corr("reg_pallas", _build_reg, _lookup_reg_pallas)
+    if "alt_pallas" not in _BUILDERS:
+        register_corr("alt_pallas", _build_alt, _lookup_alt_pallas)

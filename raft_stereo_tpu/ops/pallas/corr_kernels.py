@@ -1,0 +1,281 @@
+"""Pallas TPU kernels for the correlation hot path.
+
+TPU-native equivalents of the reference's CUDA extension
+(sampler/sampler_kernel.cu — see SURVEY §2.2 N1/N2):
+
+* :func:`windowed_sample_pallas` — fused pyramid-lookup kernel. Semantics of
+  the CUDA forward (sampler_kernel.cu:20-60): per output pixel, blend ``2r+2``
+  integer taps around ``floor(center)-r`` with weights ``1-dx``/``dx``; taps
+  outside the row read as zero. One grid program handles a block of rows with
+  the volume slab resident in VMEM, so HBM sees ONE pass over the volume per
+  lookup instead of the ~2r+2 masked-reduce passes the pure-JAX formulation
+  costs under XLA.
+* its hand-written backward (sampler_kernel.cu:63-105): the window-local
+  scatter into the volume gradient, again one VMEM-resident pass; the coords
+  gradient is ``sum_k ct_k * (g[k+1] - g[k])`` through the fractional weight
+  (``floor`` contributes zero, matching ``coords1.detach()`` usage,
+  core/corr.py:29).
+* :func:`alt_windowed_corr_pallas` — the fused "alt" kernel: builds each
+  row's correlation slice with an in-kernel MXU matmul (fmap1 row x fmap2
+  row^T / sqrt(D)) and samples it without ever writing the O(W^2) volume to
+  HBM — the capability the reference's absent ``alt_cuda_corr`` extension
+  promises (core/corr.py:159-188), with O(W) HBM footprint.
+
+On non-TPU backends every ``pallas_call`` runs in interpreter mode, so the
+same kernels are unit-testable on CPU (tests/test_pallas_corr.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _row_block(h: int) -> int:
+    """Rows per grid program; volume slab must stay well under VMEM."""
+    for hb in (8, 4, 2):
+        if h % hb == 0:
+            return hb
+    return 1
+
+
+# --------------------------------------------------------------- reg lookup
+
+def _lookup_fwd_kernel(radius, coords_ref, vol_ref, out_ref):
+    c = coords_ref[...]                      # (Hb, W1)
+    vol = vol_ref[...].astype(jnp.float32)   # (Hb, W1, W2)
+    k = 2 * radius + 1
+    w2 = vol.shape[-1]
+
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    base = base_f.astype(jnp.int32) - radius
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
+    taps = [jnp.sum(jnp.where(idx == j, vol, 0.0), axis=-1)
+            for j in range(k + 1)]
+    g = jnp.stack(taps, axis=-1)             # (Hb, W1, 2r+2)
+    out_ref[...] = (1.0 - frac) * g[..., :k] + frac * g[..., 1:]
+
+
+def _lookup_bwd_kernel(radius, coords_ref, vol_ref, ct_ref, dvol_ref,
+                       dcoords_ref):
+    c = coords_ref[...]                      # (Hb, W1)
+    vol = vol_ref[...].astype(jnp.float32)   # (Hb, W1, W2)
+    ct = ct_ref[...].astype(jnp.float32)     # (Hb, W1, 2r+1)
+    k = 2 * radius + 1
+    w2 = vol.shape[-1]
+
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    base = base_f.astype(jnp.int32) - radius
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
+
+    # dg_j = (1-f)*ct_j + f*ct_{j-1}, j in [0, 2r+1]
+    zeros = jnp.zeros_like(ct[..., :1])
+    dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
+          + jnp.concatenate([zeros, frac * ct], axis=-1))
+    dvol = jnp.zeros_like(vol)
+    for j in range(k + 1):
+        dvol = dvol + jnp.where(idx == j, dg[..., j:j + 1], 0.0)
+    dvol_ref[...] = dvol
+
+    # g taps, for the coords gradient through frac
+    taps = [jnp.sum(jnp.where(idx == j, vol, 0.0), axis=-1)
+            for j in range(k + 1)]
+    g = jnp.stack(taps, axis=-1)
+    dcoords_ref[...] = jnp.sum(ct * (g[..., 1:] - g[..., :k]), axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def windowed_sample_pallas(volume: jax.Array, center: jax.Array,
+                           radius: int) -> jax.Array:
+    """Pallas 2r+1-tap windowed linear sample along the last axis.
+
+    Drop-in for :func:`raft_stereo_tpu.ops.sampler.windowed_linear_sample`:
+    ``volume (B, H, W1, W2)``, ``center (B, H, W1)`` -> ``(B, H, W1, 2r+1)``.
+    """
+    return _ws_pallas_fwd(volume, center, radius)[0]
+
+
+def _ws_pallas_fwd(volume, center, radius):
+    b, h, w1, w2 = volume.shape
+    hb = _row_block(h)
+    k = 2 * radius + 1
+    out = pl.pallas_call(
+        functools.partial(_lookup_fwd_kernel, radius),
+        grid=(b, h // hb),
+        in_specs=[
+            pl.BlockSpec((1, hb, w1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, hb, w1, w2), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hb, w1, k), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w1, k), jnp.float32),
+        interpret=_interpret(),
+    )(center.astype(jnp.float32), volume)
+    return out, (volume, center)
+
+
+def _ws_pallas_bwd(radius, res, ct):
+    volume, center = res
+    b, h, w1, w2 = volume.shape
+    hb = _row_block(h)
+    k = 2 * radius + 1
+    dvol, dcoords = pl.pallas_call(
+        functools.partial(_lookup_bwd_kernel, radius),
+        grid=(b, h // hb),
+        in_specs=[
+            pl.BlockSpec((1, hb, w1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, hb, w1, w2), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, w1, k), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hb, w1, w2), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, w1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, w1, w2), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, w1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(center.astype(jnp.float32), volume, ct.astype(jnp.float32))
+    return dvol.astype(volume.dtype), dcoords.astype(center.dtype)
+
+
+windowed_sample_pallas.defvjp(_ws_pallas_fwd, _ws_pallas_bwd)
+
+
+# ----------------------------------------------------- fused alt (no volume)
+
+def _alt_fwd_kernel(radius, scale, coords_ref, f1_ref, f2_ref, out_ref):
+    c = coords_ref[0]                            # (Hb, W1)
+    f1 = f1_ref[0]                               # (Hb, W1, D)
+    f2 = f2_ref[0]                               # (Hb, W2, D)
+    k = 2 * radius + 1
+    w2 = f2.shape[1]
+
+    # per-row correlation slab on the MXU; never leaves VMEM
+    vol = jax.lax.dot_general(
+        f1, f2, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale   # (Hb, W1, W2)
+
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    base = base_f.astype(jnp.int32) - radius
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
+    taps = [jnp.sum(jnp.where(idx == j, vol, 0.0), axis=-1)
+            for j in range(k + 1)]
+    g = jnp.stack(taps, axis=-1)
+    out_ref[0] = (1.0 - frac) * g[..., :k] + frac * g[..., 1:]
+
+
+def _alt_bwd_kernel(radius, scale, coords_ref, f1_ref, f2_ref, ct_ref,
+                    df1_ref, df2_ref):
+    c = coords_ref[0]
+    f1 = f1_ref[0]
+    f2 = f2_ref[0]
+    ct = ct_ref[0].astype(jnp.float32)
+    k = 2 * radius + 1
+    w2 = f2.shape[1]
+
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    base = base_f.astype(jnp.int32) - radius
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
+
+    zeros = jnp.zeros_like(ct[..., :1])
+    dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
+          + jnp.concatenate([zeros, frac * ct], axis=-1))
+    dvol = jnp.zeros((f1.shape[0], f1.shape[1], w2), jnp.float32)
+    
+    for j in range(k + 1):
+        dvol = dvol + jnp.where(idx == j, dg[..., j:j + 1], 0.0)
+    dvol = dvol * scale
+
+    # dvol: (Hb, W1, W2); f2: (Hb, W2, D) -> df1 (Hb, W1, D)
+    df1_ref[0] = jax.lax.dot_general(
+        dvol, f2.astype(jnp.float32),
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(df1_ref.dtype)
+    # dvol^T contraction over W1: f1 (Hb, W1, D) -> df2 (Hb, W2, D)
+    df2_ref[0] = jax.lax.dot_general(
+        dvol, f1.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(df2_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def alt_windowed_corr_pallas(fmap1: jax.Array, fmap2: jax.Array,
+                             center: jax.Array, radius: int) -> jax.Array:
+    """Fused on-the-fly correlation lookup: ``dot + window-sample`` per row.
+
+    ``fmap1 (B, H, W1, D)``, ``fmap2 (B, H, W2, D)``, ``center (B, H, W1)``
+    -> ``(B, H, W1, 2r+1)`` with the 1/sqrt(D) scaling applied. The O(W^2)
+    correlation slab exists only in VMEM (the reference "alt" semantics,
+    core/corr.py:64-107, without the per-pixel grid_sample gathers).
+
+    The coords gradient is intentionally not produced (the model detaches
+    coords each iteration, raft_stereo.py:109, and the reference CUDA
+    backward likewise returns None for coords, core/corr.py:29).
+    """
+    return _alt_pallas_fwd(fmap1, fmap2, center, radius)[0]
+
+
+def _alt_pallas_fwd(fmap1, fmap2, center, radius):
+    b, h, w1, d = fmap1.shape
+    w2 = fmap2.shape[2]
+    hb = _row_block(h)
+    k = 2 * radius + 1
+    scale = 1.0 / float(d) ** 0.5
+    out = pl.pallas_call(
+        functools.partial(_alt_fwd_kernel, radius, scale),
+        grid=(b, h // hb),
+        in_specs=[
+            pl.BlockSpec((1, hb, w1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, hb, w1, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, w2, d), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hb, w1, k), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w1, k), jnp.float32),
+        interpret=_interpret(),
+    )(center.astype(jnp.float32), fmap1, fmap2)
+    return out, (fmap1, fmap2, center)
+
+
+def _alt_pallas_bwd(radius, res, ct):
+    fmap1, fmap2, center = res
+    b, h, w1, d = fmap1.shape
+    w2 = fmap2.shape[2]
+    hb = _row_block(h)
+    k = 2 * radius + 1
+    scale = 1.0 / float(d) ** 0.5
+    df1, df2 = pl.pallas_call(
+        functools.partial(_alt_bwd_kernel, radius, scale),
+        grid=(b, h // hb),
+        in_specs=[
+            pl.BlockSpec((1, hb, w1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, hb, w1, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, w2, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, w1, k), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hb, w1, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, w2, d), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, w1, d), fmap1.dtype),
+            jax.ShapeDtypeStruct((b, h, w2, d), fmap2.dtype),
+        ],
+        interpret=_interpret(),
+    )(center.astype(jnp.float32), fmap1, fmap2, ct.astype(jnp.float32))
+    return df1, df2, None
+
+
+alt_windowed_corr_pallas.defvjp(_alt_pallas_fwd, _alt_pallas_bwd)

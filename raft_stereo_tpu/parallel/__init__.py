@@ -3,6 +3,10 @@ from raft_stereo_tpu.parallel.data_parallel import (
     make_pjit_train_step,
     make_shardmap_train_step,
 )
+from raft_stereo_tpu.parallel.ring_corr import (
+    make_ring_lookup,
+    ring_corr_lookup,
+)
 from raft_stereo_tpu.parallel.mesh import (
     DATA_AXIS,
     SEQ_AXIS,

@@ -1,0 +1,78 @@
+"""Multi-host distributed setup (SURVEY §5: the comm-backend the reference
+lacks — its only cluster awareness is a SLURM env var for loader workers,
+stereo_datasets.py:318).
+
+JAX's runtime owns the collectives: after :func:`initialize`, every process
+sees the global device set; meshes built from it span hosts, and the SAME
+``psum``/halo/``ppermute`` layout as single-host rides ICI within a slice and
+DCN across slices — no NCCL/MPI analog to manage.
+
+Data feeding follows the standard JAX multi-host recipe: each process loads
+only its shard of the global batch (:func:`process_batch_slice`) and
+:func:`host_local_to_global` assembles the global sharded arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_stereo_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, batch_specs
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host job (no-op when single-process).
+
+    With no arguments JAX auto-detects cluster environments (TPU pods, SLURM,
+    GKE). Call before any other JAX API touches devices.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(data_parallel: int = 0, seq_parallel: int = 1) -> Mesh:
+    """A ``(data, seq)`` mesh over the GLOBAL device set (all hosts).
+
+    Device order keeps each host's local devices contiguous along ``data`` so
+    gradient psums cross DCN only at slice boundaries.
+    """
+    from raft_stereo_tpu.parallel.mesh import make_mesh
+    return make_mesh(data_parallel, seq_parallel, devices=jax.devices())
+
+
+def process_batch_slice(global_batch_size: int) -> slice:
+    """The half-open index range of the global batch this process must load."""
+    n, i = jax.process_count(), jax.process_index()
+    if global_batch_size % n:
+        raise ValueError(f"global batch {global_batch_size} not divisible by "
+                         f"{n} processes")
+    per = global_batch_size // n
+    return slice(i * per, (i + 1) * per)
+
+
+def host_local_to_global(mesh: Mesh, batch: Dict[str, np.ndarray]
+                         ) -> Dict[str, jax.Array]:
+    """Assemble per-process batch shards into global sharded arrays.
+
+    Single-process: equivalent to :func:`raft_stereo_tpu.parallel.shard_batch`.
+    Multi-process: each host contributes its local slice of the batch axis via
+    ``jax.make_array_from_process_local_data``.
+    """
+    specs = batch_specs(mesh)
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, specs[k]) for k, v in batch.items()}
+    n = jax.process_count()
+    out = {}
+    for k, v in batch.items():
+        global_shape = (v.shape[0] * n,) + v.shape[1:]
+        out[k] = jax.make_array_from_process_local_data(
+            specs[k], np.asarray(v), global_shape)
+    return out

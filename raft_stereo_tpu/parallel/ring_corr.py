@@ -1,0 +1,114 @@
+"""Ring-sharded correlation: sequence parallelism over the disparity axis.
+
+The W2 (disparity-search) axis is this model family's "sequence" axis: the
+O(H*W^2) correlation volume is what limits resolution (SURVEY §5 long-context
+row; the reference's only recourses are the slower "alt" mode and lower-res
+inference, README.md:132,152). For images too wide for one chip, this module
+shards BOTH feature maps over the width axis of a mesh and computes the
+pyramid lookup ring-style, ring-attention-shaped but for correlation:
+
+* each device holds one W-shard of fmap1 (its output rows) and one W-shard of
+  fmap2 (one block of the disparity search range),
+* at every ring step a device computes its fmap1-shard's correlation against
+  the fmap2 block it currently holds (an MXU matmul) and the windowed-sample
+  contribution of that block, then passes the block along the ring with
+  ``ppermute`` over ICI,
+* contributions are EXACT partial sums: the windowed sampler's
+  equality-masked taps read zero outside the held block, and the fractional
+  blend is linear, so summing per-block samples reproduces the global lookup
+  bit-for-bit (up to fp addition order).
+
+Per-device memory is O(W_local * D + W_local * r) — no volume, no gather, no
+all-gather of fmap2. Compute overlaps communication in the usual ring
+pipeline fashion (XLA schedules the ppermute DMA against the next block's
+matmul).
+
+This is the explicit-collective sequence-parallel path, the SP analog of
+``make_shardmap_train_step``'s DP; the auto-SPMD ``(data, seq)`` pjit path
+(parallel/data_parallel.py) remains the default for moderate widths.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from raft_stereo_tpu.ops.geometry import pool_w2
+from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
+
+
+def ring_corr_lookup(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
+                     *, radius: int = 4, num_levels: int = 4,
+                     axis_name: str = SEQ_AXIS) -> jax.Array:
+    """Sharded pyramid correlation lookup; call inside ``shard_map``.
+
+    Args (per-device shards; width axis sharded over ``axis_name``):
+      fmap1: ``(B, H, W1_local, D)`` left features for this device's columns.
+      fmap2: ``(B, H, W2_local, D)`` one block of right features.
+      coords: ``(B, H, W1_local)`` lookup centers in GLOBAL level-0 pixels.
+
+    Returns:
+      ``(B, H, W1_local, num_levels * (2*radius+1))`` correlation features,
+      identical to the unsharded "alt" lookup on the gathered maps.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    w2_local = fmap2.shape[2]
+    d = fmap1.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    if w2_local % (1 << (num_levels - 1)):
+        raise ValueError(f"local W2 {w2_local} must be divisible by "
+                         f"2^{num_levels - 1} so pyramid pooling stays local")
+
+    f1 = fmap1.astype(jnp.float32)
+
+    def local_pyramid(f2):
+        levels = [f2]
+        for _ in range(num_levels - 1):
+            levels.append(pool_w2(levels[-1]))
+        return tuple(levels)
+
+    out = None
+    block = fmap2.astype(jnp.float32)
+    for step in range(n):
+        src = (my - step) % n  # global index of the block currently held
+        contrib = []
+        for i, blk in enumerate(local_pyramid(block)):
+            # this block covers global level-i range [src*w2l_i, (src+1)*w2l_i)
+            w2l_i = w2_local >> i
+            offset = (src * w2l_i).astype(jnp.float32)
+            vol = jnp.einsum("bhwd,bhvd->bhwv", f1, blk,
+                             preferred_element_type=jnp.float32)
+            contrib.append(windowed_linear_sample(
+                vol, coords / (2 ** i) - offset, radius) * scale)
+        partial = jnp.concatenate(contrib, axis=-1)
+        out = partial if out is None else out + partial
+        if step + 1 < n:
+            block = jax.lax.ppermute(
+                block, axis_name,
+                perm=[(k, (k + 1) % n) for k in range(n)])
+    return out
+
+
+def make_ring_lookup(mesh: Mesh, *, radius: int = 4, num_levels: int = 4):
+    """Wrap :func:`ring_corr_lookup` in shard_map over the mesh's seq axis.
+
+    Returns a function of GLOBAL arrays ``(fmap1, fmap2, coords) -> corr``
+    whose intermediates are fully W-sharded.
+    """
+    spec_f = P(None, None, SEQ_AXIS, None)
+    spec_c = P(None, None, SEQ_AXIS)
+
+    def lookup(fmap1, fmap2, coords):
+        return ring_corr_lookup(fmap1, fmap2, coords, radius=radius,
+                                num_levels=num_levels, axis_name=SEQ_AXIS)
+
+    return shard_map(lookup, mesh=mesh,
+                     in_specs=(spec_f, spec_f, spec_c),
+                     out_specs=spec_c,
+                     check_rep=False)

@@ -93,16 +93,18 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         log = Logger(total_steps=int(state.step))
         validation_predictor = None  # built lazily, reused across validations
         t_start, imgs_done = time.perf_counter(), 0
+        global_step = int(state.step)
+        pending = None  # lagged metrics fetch: sync step i-1 while i runs
         for batch in infinite_batches(loader):
-            global_step = int(state.step)
             if global_step >= cfg.num_steps:
                 break
             placed = shard_batch(mesh, batch)
             state, metrics = step_fn(state, placed)
-            # host fetch = step synchronization + metric values
-            metrics = {k: float(v) for k, v in metrics.items()}
+            if pending is not None:
+                log.push({k: float(v) for k, v in pending.items()},
+                         lr=float(schedule(global_step - 1)))
+            pending = metrics
             imgs_done += cfg.batch_size
-            log.push(metrics, lr=float(schedule(global_step)))
             global_step += 1
 
             if global_step % validation_frequency == 0:
@@ -125,6 +127,9 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                             imgs_done / max(dt, 1e-9))
                 t_start, imgs_done = time.perf_counter(), 0
 
+        if pending is not None:
+            log.push({k: float(v) for k, v in pending.items()},
+                     lr=float(schedule(global_step - 1)))
         final = save_train_state(cfg.ckpt_dir, cfg.name, state)
         log.close()
     logger.info("training done: %s", final)

@@ -1,0 +1,86 @@
+"""Auxiliary utilities completing the reference's component inventory.
+
+Everything here is *dead code in the reference* (never called from any entry
+point — SURVEY §2 components 6 and 8) but part of its public surface, so
+working equivalents are provided:
+
+* :func:`transfer_color` — LAB-space color statistics transfer
+  (core/utils/augmentor.py:18-30).
+* :func:`get_middlebury_images` / :func:`get_eth3d_images` /
+  :func:`get_kitti_images` — dataset image-path globs
+  (core/utils/augmentor.py:33-45).
+* :func:`forward_interpolate` — forward-splat a flow field onto the next
+  frame's grid by nearest-scatter + griddata fill (core/utils/utils.py:28-56).
+* :func:`gauss_blur` — Gaussian blur via padding + 2-D filter
+  (core/utils/utils.py:87-94).
+"""
+
+from __future__ import annotations
+
+from glob import glob
+
+import numpy as np
+
+
+def transfer_color(source: np.ndarray, target: np.ndarray) -> np.ndarray:
+    """Match ``source``'s per-channel LAB mean/std to ``target``'s.
+
+    Classic Reinhard color transfer; uint8 RGB in, float32 RGB out.
+    """
+    import cv2
+
+    src = cv2.cvtColor(source.astype(np.float32) / 255.0,
+                       cv2.COLOR_RGB2LAB)
+    tgt = cv2.cvtColor(target.astype(np.float32) / 255.0,
+                       cv2.COLOR_RGB2LAB)
+    s_mean, s_std = src.reshape(-1, 3).mean(0), src.reshape(-1, 3).std(0)
+    t_mean, t_std = tgt.reshape(-1, 3).mean(0), tgt.reshape(-1, 3).std(0)
+    out = (src - s_mean) * (t_std / np.maximum(s_std, 1e-6)) + t_mean
+    out = cv2.cvtColor(out.astype(np.float32), cv2.COLOR_LAB2RGB)
+    return np.clip(out * 255.0, 0, 255).astype(np.float32)
+
+
+def get_middlebury_images(root: str = "datasets/Middlebury"):
+    return sorted(glob(f"{root}/MiddEval3/trainingF/*/im0.png"))
+
+
+def get_eth3d_images(root: str = "datasets/ETH3D"):
+    return sorted(glob(f"{root}/two_view_training/*/im0.png"))
+
+
+def get_kitti_images(root: str = "datasets/KITTI"):
+    return sorted(glob(f"{root}/training/image_2/*_10.png"))
+
+
+def forward_interpolate(flow: np.ndarray) -> np.ndarray:
+    """Forward-warp a flow field to the next frame (utils.py:28-56).
+
+    ``flow``: (2, H, W) or (H, W, 2); returns the same layout, with holes
+    filled by nearest-neighbour interpolation.
+    """
+    from scipy import interpolate as sp_interpolate
+
+    chw = flow.shape[0] == 2 and flow.ndim == 3 and flow.shape[-1] != 2
+    f = flow if chw else flow.transpose(2, 0, 1)
+    dx, dy = f[0], f[1]
+    ht, wd = dx.shape
+    x0, y0 = np.meshgrid(np.arange(wd), np.arange(ht))
+    x1, y1 = (x0 + dx).reshape(-1), (y0 + dy).reshape(-1)
+    dx, dy = dx.reshape(-1), dy.reshape(-1)
+    valid = (x1 > 0) & (x1 < wd) & (y1 > 0) & (y1 < ht)
+    x1, y1, dx, dy = x1[valid], y1[valid], dx[valid], dy[valid]
+
+    flow_x = sp_interpolate.griddata((x1, y1), dx, (x0, y0), method="nearest",
+                                     fill_value=0)
+    flow_y = sp_interpolate.griddata((x1, y1), dy, (x0, y0), method="nearest",
+                                     fill_value=0)
+    out = np.stack([flow_x, flow_y], axis=0).astype(np.float32)
+    return out if chw else out.transpose(1, 2, 0)
+
+
+def gauss_blur(img: np.ndarray, ksize: int = 5, sigma: float = 1.0
+               ) -> np.ndarray:
+    """Gaussian blur of an (H, W, C) image (utils.py:87-94)."""
+    import cv2
+
+    return cv2.GaussianBlur(img, (ksize, ksize), sigma)

@@ -1,0 +1,122 @@
+"""Stereo-to-3D visualization pipeline (the reference's fork-specific
+visualize_droid_trajectory_3d.py, SURVEY §2 component 12, rebuilt as a
+library).
+
+The reference couples this pipeline to the proprietary ZED SDK (``pyzed``)
+and a hard-coded checkpoint path. Here the geometry and rendering are
+SDK-free and the frame source is pluggable: anything yielding left/right
+numpy images works (a ZED-SVO-backed source can be added where the SDK
+exists). Capabilities covered:
+
+* disparity -> metric depth (``f*B/d``, visualize_droid_trajectory_3d.py:67-73)
+* depth -> camera/world point clouds with extrinsics
+  (:func:`depth_to_cloud`, reference :203-247)
+* DROID trajectory parsing from ``trajectory.h5`` (:342-366; needs h5py)
+* matplotlib 3-D scatter rendering of trajectory sweeps (:250-339)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CameraIntrinsics:
+    """Pinhole intrinsics; ``baseline`` in the same units as desired depth."""
+
+    fx: float
+    fy: float
+    cx: float
+    cy: float
+    baseline: float
+
+
+def disparity_to_depth(disparity: np.ndarray, cam: CameraIntrinsics,
+                       min_disp: float = 1e-3) -> np.ndarray:
+    """``depth = fx * baseline / disparity`` (reference :67-73); non-positive
+    disparities map to 0 depth."""
+    disp = np.asarray(disparity, np.float32)
+    depth = np.zeros_like(disp)
+    ok = disp > min_disp
+    depth[ok] = cam.fx * cam.baseline / disp[ok]
+    return depth
+
+
+def depth_to_cloud(depth: np.ndarray, cam: CameraIntrinsics,
+                   pose: Optional[np.ndarray] = None,
+                   color: Optional[np.ndarray] = None,
+                   max_depth: float = np.inf,
+                   stride: int = 1) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Back-project a depth map to a point cloud (reference :203-236).
+
+    ``pose``: optional 4x4 camera-to-world matrix; ``color``: (H, W, 3) image
+    sampled at the same pixels. Returns ``(points (N, 3), colors (N, 3)|None)``.
+    """
+    h, w = depth.shape
+    ys, xs = np.mgrid[0:h:stride, 0:w:stride]
+    z = depth[::stride, ::stride]
+    ok = (z > 0) & (z < max_depth)
+    z = z[ok]
+    x = (xs[ok] - cam.cx) * z / cam.fx
+    y = (ys[ok] - cam.cy) * z / cam.fy
+    pts = np.stack([x, y, z], axis=-1)
+    if pose is not None:
+        pts = pts @ pose[:3, :3].T + pose[:3, 3]
+    cols = None
+    if color is not None:
+        cols = color[::stride, ::stride][ok]
+    return pts.astype(np.float32), cols
+
+
+def load_droid_trajectory(path: str) -> np.ndarray:
+    """Parse a DROID ``trajectory.h5`` into (T, 4, 4) camera-to-world poses
+    (reference :346-366: translation + quaternion rows)."""
+    import h5py
+    from scipy.spatial.transform import Rotation
+
+    with h5py.File(path, "r") as f:
+        traj = np.asarray(f["trajectory"] if "trajectory" in f
+                          else f[list(f.keys())[0]])
+    poses = np.tile(np.eye(4, dtype=np.float32), (len(traj), 1, 1))
+    poses[:, :3, 3] = traj[:, :3]
+    poses[:, :3, :3] = Rotation.from_quat(traj[:, 3:7]).as_matrix()
+    return poses
+
+
+def render_clouds(clouds: Sequence[Tuple[np.ndarray, Optional[np.ndarray]]],
+                  out_path: str, elev: float = -60.0, azim: float = -90.0,
+                  point_size: float = 0.3) -> None:
+    """Matplotlib 3-D scatter of point-cloud sweeps (reference :250-339)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig = plt.figure(figsize=(10, 10))
+    ax = fig.add_subplot(projection="3d")
+    for pts, cols in clouds:
+        ax.scatter(pts[:, 0], pts[:, 1], pts[:, 2], s=point_size,
+                   c=None if cols is None else np.clip(cols / 255.0, 0, 1))
+    ax.view_init(elev=elev, azim=azim)
+    fig.savefig(out_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+
+def process_stereo_sequence(predictor, frames: Iterable, cam: CameraIntrinsics,
+                            poses: Optional[np.ndarray] = None,
+                            iters: int = 32, max_depth: float = 10.0,
+                            stride: int = 4):
+    """RAFT depth + reprojection over a stereo sequence (reference :164-247).
+
+    ``predictor``: a :class:`raft_stereo_tpu.inference.StereoPredictor`;
+    ``frames``: iterable of ``(left_rgb, right_rgb)`` numpy pairs. Yields
+    ``(points, colors)`` per frame, in world coordinates when ``poses`` given.
+    """
+    for t, (left, right) in enumerate(frames):
+        disp = predictor.compute_disparity(left, right, iters=iters)
+        depth = disparity_to_depth(disp, cam)
+        pose = None if poses is None else poses[t]
+        yield depth_to_cloud(depth, cam, pose=pose, color=left,
+                             max_depth=max_depth, stride=stride)

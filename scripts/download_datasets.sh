@@ -1,0 +1,21 @@
+#!/bin/bash
+# Middlebury MiddEval3 (Q/H/F + GT) and ETH3D two-view sets, laid out as
+# raft_stereo_tpu/data/datasets.py expects under datasets/.
+set -e
+mkdir -p datasets/Middlebury datasets/ETH3D
+cd datasets/Middlebury
+mkdir -p MiddEval3
+wget -nc https://www.dropbox.com/s/fn8siy5muak3of3/official_train.txt -P MiddEval3/
+for split in Q H F; do
+  wget -nc https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-data-$split.zip
+  unzip -on MiddEval3-data-$split.zip
+  wget -nc https://vision.middlebury.edu/stereo/submit3/zip/MiddEval3-GT0-$split.zip
+  unzip -on MiddEval3-GT0-$split.zip
+done
+cd ../ETH3D
+wget -nc https://www.eth3d.net/data/two_view_training.7z
+7z x -y two_view_training.7z -otwo_view_training
+wget -nc https://www.eth3d.net/data/two_view_training_gt.7z
+7z x -y two_view_training_gt.7z -otwo_view_training_gt
+wget -nc https://www.eth3d.net/data/two_view_test.7z
+7z x -y two_view_test.7z -otwo_view_test

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Middlebury 2014 scenes (perfect + imperfect rectification), for the
+# middlebury_2014 training mixture (datasets.py Middlebury split="2014").
+set -e
+mkdir -p datasets/Middlebury/2014
+cd datasets/Middlebury/2014
+scenes="Adirondack Backpack Bicycle1 Cable Classroom1 Couch Flowers Jadeplant
+Mask Motorcycle Piano Pipes Playroom Playtable Recycle Shelves Shopvac Sticks
+Storage Sword1 Sword2 Umbrella Vintage"
+for s in $scenes; do
+  for kind in perfect imperfect; do
+    wget -nc https://vision.middlebury.edu/stereo/data/scenes2014/zip/$s-$kind.zip
+    unzip -on $s-$kind.zip
+  done
+done

@@ -1,0 +1,8 @@
+#!/bin/bash
+# Fetch the released RAFT-Stereo checkpoints (raftstereo-{sceneflow,middlebury,
+# eth3d,realtime}.pth, iraftstereo_rvc.pth). These are the reference's weights;
+# the framework loads .pth directly via utils/checkpoint_convert.py.
+set -e
+mkdir -p models && cd models
+wget https://www.dropbox.com/s/ftveifyqcomiwaq/models.zip
+unzip -o models.zip && rm models.zip

@@ -285,3 +285,41 @@ def test_sparse_flip_keeps_valid_aligned():
     for y, x in zip(ys, xs):
         assert flow_a[y, x, 0] == -3.0
         assert flow_a[y, x, 1] == 0.0
+
+
+def test_extras_utilities():
+    """Dead-code-parity utilities (SURVEY components 6/8) work."""
+    from raft_stereo_tpu.utils.extras import (forward_interpolate, gauss_blur,
+                                              transfer_color)
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 255, (16, 20, 3), dtype=np.uint8)
+    b = rng.integers(0, 255, (16, 20, 3), dtype=np.uint8)
+    out = transfer_color(a, b)
+    assert out.shape == a.shape and np.isfinite(out).all()
+
+    flow = rng.normal(scale=2.0, size=(8, 10, 2)).astype(np.float32)
+    warped = forward_interpolate(flow)
+    assert warped.shape == flow.shape and np.isfinite(warped).all()
+    chw = forward_interpolate(flow.transpose(2, 0, 1))
+    assert chw.shape == (2, 8, 10)
+
+    img = rng.normal(size=(12, 14, 3)).astype(np.float32)
+    assert gauss_blur(img).shape == img.shape
+
+
+def test_visualize_geometry():
+    """disparity->depth->cloud round trip (SURVEY component 12)."""
+    from raft_stereo_tpu.visualize import (CameraIntrinsics, depth_to_cloud,
+                                           disparity_to_depth)
+    cam = CameraIntrinsics(fx=100.0, fy=100.0, cx=10.0, cy=8.0, baseline=0.12)
+    disp = np.full((16, 20), 6.0, np.float32)
+    depth = disparity_to_depth(disp, cam)
+    np.testing.assert_allclose(depth, 100.0 * 0.12 / 6.0)
+    pts, cols = depth_to_cloud(depth, cam,
+                               color=np.zeros((16, 20, 3), np.uint8))
+    assert pts.shape[1] == 3 and len(pts) == len(cols) == 16 * 20
+    # pixel at (cx, cy) projects to the optical axis
+    pose = np.eye(4); pose[:3, 3] = [1.0, 2.0, 3.0]
+    pts_w, _ = depth_to_cloud(depth, cam, pose=pose)
+    np.testing.assert_allclose(pts_w.mean(0) - pts.mean(0), [1.0, 2.0, 3.0],
+                               atol=1e-5)

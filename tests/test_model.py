@@ -7,7 +7,7 @@ from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.models import create_model, init_model
 
 
-SMALL = (1, 64, 96, 3)
+SMALL = (1, 32, 64, 3)
 
 
 def _images(rng, shape=SMALL):
@@ -28,7 +28,7 @@ class TestForward:
         cfg, model, variables = default_model
         img1, img2 = _images(np.random.default_rng(0))
         preds = model.apply(variables, img1, img2, iters=4)
-        assert preds.shape == (4, 1, 64, 96, 1)
+        assert preds.shape == (4, 1, 32, 64, 1)
         assert bool(jnp.isfinite(preds).all())
 
     def test_test_mode_matches_last_train_prediction(self, default_model):
@@ -40,7 +40,7 @@ class TestForward:
         low, up = model.apply(variables, img1, img2, iters=3, test_mode=True)
         np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(up),
                                    rtol=1e-5, atol=1e-5)
-        assert low.shape == (1, 16, 24, 2)
+        assert low.shape == (1, 8, 16, 2)
 
     def test_iterations_refine(self, default_model):
         """More iterations must change the prediction (the GRU is doing work)."""
@@ -54,8 +54,8 @@ class TestForward:
         cfg, model, variables = default_model
         img1, img2 = _images(np.random.default_rng(3))
         low0, _ = model.apply(variables, img1, img2, iters=1, test_mode=True)
-        finit = jnp.concatenate([jnp.full((1, 16, 24, 1), -3.0),
-                                 jnp.zeros((1, 16, 24, 1))], axis=-1)
+        finit = jnp.concatenate([jnp.full((1, 8, 16, 1), -3.0),
+                                 jnp.zeros((1, 8, 16, 1))], axis=-1)
         low1, _ = model.apply(variables, img1, img2, iters=1, test_mode=True,
                               flow_init=finit)
         # starting point moved by -3 along x
@@ -107,7 +107,7 @@ class TestVariants:
         model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
         img1, img2 = _images(np.random.default_rng(7))
         _, up = model.apply(variables, img1, img2, iters=2, test_mode=True)
-        assert up.shape == (1, 64, 96, 1)
+        assert up.shape == (1, 32, 64, 1)
 
     def test_realtime_configuration(self):
         """shared_backbone + n_downsample 3 + 2 GRU layers + slow_fast_gru
@@ -118,8 +118,8 @@ class TestVariants:
         model, variables = init_model(jax.random.PRNGKey(0), cfg, SMALL)
         img1, img2 = _images(np.random.default_rng(8))
         low, up = model.apply(variables, img1, img2, iters=7, test_mode=True)
-        assert low.shape == (1, 8, 12, 2)  # 1/8 resolution
-        assert up.shape == (1, 64, 96, 1)
+        assert low.shape == (1, 4, 8, 2)  # 1/8 resolution
+        assert up.shape == (1, 32, 64, 1)
 
     def test_mixed_precision_bf16(self):
         cfg = RAFTStereoConfig(mixed_precision=True)
@@ -151,4 +151,4 @@ class TestVariants:
             return model.apply(variables, i1, i2, iters=2, test_mode=True)
 
         low, up = fwd(variables, img1, img2)
-        assert up.shape == (1, 64, 96, 1)
+        assert up.shape == (1, 32, 64, 1)

@@ -1,28 +1,11 @@
 #!/usr/bin/env python
-"""Training CLI (reference train_stereo.py:214-258, same flag surface)."""
+"""Training CLI (reference train_stereo.py:214-258, same flag surface).
 
-import argparse
-import logging
+Thin wrapper over the installable console entry point
+(``raft_stereo_tpu.cli:_train_main`` == ``raft-stereo-train``).
+"""
 
-from raft_stereo_tpu import cli
-from raft_stereo_tpu.training.trainer import train
-
-
-def main():
-    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU training")
-    cli.add_train_args(parser)
-    cli.add_model_args(parser)
-    args = parser.parse_args()
-
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
-
-    model_cfg = cli.model_config(args)
-    train_cfg = cli.train_config(args)
-    final = train(model_cfg, train_cfg)
-    print(f"final checkpoint: {final}")
-
+from raft_stereo_tpu.cli import _train_main
 
 if __name__ == "__main__":
-    main()
+    _train_main()
