@@ -1,0 +1,113 @@
+// Native host-side data-path kernels for raft_stereo_tpu.
+//
+// The reference delegates its host data path to torch's C++ DataLoader
+// machinery (SURVEY §2.1 component 5); this library is the framework's own
+// native equivalent for the decode hot loop: a zero-copy (mmap) PFM decoder
+// with the bottom-up row flip and byte-order swap fused into the single
+// output write, plus a fused uint8->float32 batch collator. Exposed through
+// a minimal C ABI consumed via ctypes (no pybind11 dependency by design).
+//
+// Build: `make -C native` -> libstereodata.so. Python side:
+// raft_stereo_tpu/data/native.py (builds on demand, falls back to numpy).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Parse a PFM header. Returns 0 on success and fills width/height/channels/
+// little_endian/data_offset; negative error codes otherwise.
+int pfm_probe(const char* path, int32_t* width, int32_t* height,
+              int32_t* channels, int32_t* little_endian,
+              int64_t* data_offset) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char tag[3] = {0, 0, 0};
+  if (std::fscanf(f, "%2s", tag) != 1) { std::fclose(f); return -2; }
+  if (tag[0] != 'P' || (tag[1] != 'F' && tag[1] != 'f')) {
+    std::fclose(f);
+    return -3;
+  }
+  *channels = tag[1] == 'F' ? 3 : 1;
+  double scale;
+  if (std::fscanf(f, "%d %d %lf", width, height, &scale) != 3 ||
+      *width <= 0 || *height <= 0) {
+    std::fclose(f);
+    return -4;
+  }
+  // The scale line ends with a newline; tolerate CRLF-written files by
+  // consuming to (and including) the '\n' rather than a single byte —
+  // mirrors the numpy reference's readline() and keeps data_offset exact.
+  int ch;
+  do {
+    ch = std::fgetc(f);
+  } while (ch != '\n' && ch != EOF);
+  if (ch == EOF) { std::fclose(f); return -5; }
+  *little_endian = scale < 0.0 ? 1 : 0;
+  *data_offset = std::ftell(f);
+  std::fclose(f);
+  return 0;
+}
+
+static inline float bswap_float(float v) {
+  uint32_t u;
+  std::memcpy(&u, &v, 4);
+  u = __builtin_bswap32(u);
+  std::memcpy(&v, &u, 4);
+  return v;
+}
+
+// Decode the PFM payload at `path` into `out` (H*W*C float32, top-down row
+// order — the flip from PFM's bottom-up storage happens during the copy).
+// Returns 0 on success.
+int pfm_decode(const char* path, int64_t data_offset, int32_t width,
+               int32_t height, int32_t channels, int32_t little_endian,
+               float* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -2; }
+  const int64_t row_elems = static_cast<int64_t>(width) * channels;
+  const int64_t payload = row_elems * height * 4;
+  if (st.st_size < data_offset + payload) { close(fd); return -3; }
+
+  void* mapped = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (mapped == MAP_FAILED) return -4;
+  const float* src =
+      reinterpret_cast<const float*>(static_cast<const char*>(mapped) +
+                                     data_offset);
+
+  for (int32_t r = 0; r < height; ++r) {
+    // PFM rows run bottom-to-top; write them top-down.
+    const float* src_row = src + static_cast<int64_t>(height - 1 - r) * row_elems;
+    float* dst_row = out + static_cast<int64_t>(r) * row_elems;
+    if (little_endian) {
+      std::memcpy(dst_row, src_row, row_elems * 4);
+    } else {
+      for (int64_t i = 0; i < row_elems; ++i) dst_row[i] = bswap_float(src_row[i]);
+    }
+  }
+  munmap(mapped, st.st_size);
+  return 0;
+}
+
+// Fused collate: stack `n` uint8 HWC images into one float32 (N,H,W,C)
+// buffer (the loader's stack + astype(float32) in a single pass).
+void collate_u8_to_f32(const uint8_t** images, int32_t n, int64_t elems,
+                       float* out) {
+  for (int32_t i = 0; i < n; ++i) {
+    const uint8_t* src = images[i];
+    float* dst = out + static_cast<int64_t>(i) * elems;
+    for (int64_t j = 0; j < elems; ++j) dst[j] = static_cast<float>(src[j]);
+  }
+}
+
+}  // extern "C"

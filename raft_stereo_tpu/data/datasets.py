@@ -152,8 +152,10 @@ class StereoDataset:
             img2 = np.pad(img2, pad)
 
         return {
-            "image1": img1.astype(np.float32),
-            "image2": img2.astype(np.float32),
+            # images stay uint8 here; the loader's collate fuses the
+            # stack + float32 cast (natively when libstereodata is built)
+            "image1": np.ascontiguousarray(img1, dtype=np.uint8),
+            "image2": np.ascontiguousarray(img2, dtype=np.uint8),
             "flow": flow[..., :1].astype(np.float32),
             "valid": valid.astype(np.float32),
             "paths": tuple(self.image_list[index]) + (self.disparity_list[index],),

@@ -48,7 +48,22 @@ def read_image(path: str) -> np.ndarray:
 # --------------------------------------------------------------------------- PFM
 
 def read_pfm(path: str) -> np.ndarray:
-    """Read a PFM file -> float32 (H, W) or (H, W, 3), top-down row order."""
+    """Read a PFM file -> float32 (H, W) or (H, W, 3), top-down row order.
+
+    Uses the native mmap decoder (data/native.py, bit-identical output) when
+    the shared library is available; this numpy path is the fallback and the
+    reference implementation.
+    """
+    from raft_stereo_tpu.data import native
+
+    if native.available():
+        out = native.read_pfm(path)
+        if out is not None:
+            return out
+    return _read_pfm_numpy(path)
+
+
+def _read_pfm_numpy(path: str) -> np.ndarray:
     with open(path, "rb") as f:
         header = f.readline().rstrip()
         if header == b"PF":

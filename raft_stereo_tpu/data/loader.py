@@ -25,8 +25,23 @@ BATCH_FIELDS = ("image1", "image2", "flow", "valid")
 
 
 def _collate(samples) -> Dict[str, np.ndarray]:
-    return {k: np.stack([s[k] for s in samples], axis=0)
-            for k in BATCH_FIELDS}
+    """Stack per-sample arrays into one contiguous batch per field.
+
+    uint8 image fields are collated straight to float32 — in one native pass
+    (native/stereodata.cpp) when the library is built, else stack+astype.
+    """
+    from raft_stereo_tpu.data import native
+
+    out: Dict[str, np.ndarray] = {}
+    for k in BATCH_FIELDS:
+        arrs = [s[k] for s in samples]
+        if arrs[0].dtype == np.uint8:
+            batched = native.collate_u8(arrs) if native.available() else None
+            out[k] = (np.stack(arrs).astype(np.float32)
+                      if batched is None else batched)
+        else:
+            out[k] = np.stack(arrs)
+    return out
 
 
 class Loader:

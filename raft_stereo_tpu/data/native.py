@@ -1,0 +1,122 @@
+"""ctypes bindings to the native host-data-path library (native/stereodata.cpp).
+
+The shared library is built on demand with the system compiler the first time
+it is needed and cached next to the sources; absence of a toolchain degrades
+gracefully to the numpy implementations (``available()`` returns False). The
+decoder's output is bit-identical to :func:`frame_utils.read_pfm` (tested in
+tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libstereodata.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "stereodata.cpp")
+    if not os.path.isfile(src):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.isfile(_LIB_PATH)
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info("native data-path build failed (%s); using numpy path", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.isfile(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.info("native data-path load failed (%s)", e)
+            return None
+        lib.pfm_probe.restype = ctypes.c_int
+        lib.pfm_probe.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        lib.pfm_decode.restype = ctypes.c_int
+        lib.pfm_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.collate_u8_to_f32.restype = None
+        lib.collate_u8_to_f32.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)), ctypes.c_int32,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_float)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_pfm(path: str) -> Optional[np.ndarray]:
+    """Native PFM decode; None when the library is unavailable (caller falls
+    back to the numpy codec) — raises on malformed files like the numpy path."""
+    lib = _load()
+    if lib is None:
+        return None
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    c = ctypes.c_int32()
+    le = ctypes.c_int32()
+    off = ctypes.c_int64()
+    rc = lib.pfm_probe(path.encode(), ctypes.byref(w), ctypes.byref(h),
+                       ctypes.byref(c), ctypes.byref(le), ctypes.byref(off))
+    if rc != 0:
+        raise ValueError(f"{path}: not a valid PFM file (native rc={rc})")
+    out = np.empty((h.value, w.value, c.value) if c.value == 3
+                   else (h.value, w.value), np.float32)
+    rc = lib.pfm_decode(path.encode(), off.value, w.value, h.value, c.value,
+                        le.value,
+                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if rc != 0:
+        raise ValueError(f"{path}: truncated/unreadable PFM (native rc={rc})")
+    return out
+
+
+def collate_u8(images) -> Optional[np.ndarray]:
+    """Stack same-shaped uint8 arrays into one float32 batch in a single
+    native pass; None when unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    images = [np.ascontiguousarray(im, dtype=np.uint8) for im in images]
+    shape = images[0].shape
+    if any(im.shape != shape for im in images):
+        raise ValueError("collate_u8 requires same-shaped samples")
+    n = len(images)
+    elems = int(np.prod(shape))
+    out = np.empty((n,) + shape, np.float32)
+    ptrs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+        *[im.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) for im in images])
+    lib.collate_u8_to_f32(ptrs, n, elems,
+                          out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out

@@ -39,12 +39,24 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _row_block(h: int) -> int:
-    """Rows per grid program; volume slab must stay well under VMEM."""
-    for hb in (8, 4, 2):
-        if h % hb == 0:
+# Budget for one program's resident blocks; well under the ~16 MB/core VMEM
+# so inputs+outputs+double-buffering fit.
+_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
+
+
+def _row_block(h: int, slab_bytes_per_row: int) -> int:
+    """Rows per grid program sized by the actual VMEM slab footprint.
+
+    Returns 0 when even a single row exceeds the budget — callers must fall
+    back to the pure-JAX lookup (identical semantics). H-divisibility alone is
+    not enough: Middlebury-F-scale widths make (hb, W1, W2) slabs tens of MB.
+    """
+    if slab_bytes_per_row > _VMEM_BUDGET_BYTES:
+        return 0
+    for hb in (8, 4, 2, 1):
+        if h % hb == 0 and hb * slab_bytes_per_row <= _VMEM_BUDGET_BYTES:
             return hb
-    return 1
+    return 1 if slab_bytes_per_row <= _VMEM_BUDGET_BYTES else 0
 
 
 # --------------------------------------------------------------- reg lookup
@@ -107,8 +119,12 @@ def windowed_sample_pallas(volume: jax.Array, center: jax.Array,
 
 def _ws_pallas_fwd(volume, center, radius):
     b, h, w1, w2 = volume.shape
-    hb = _row_block(h)
+    # fwd holds vol + out; bwd additionally dvol — budget on 2x the vol slab
+    hb = _row_block(h, 2 * w1 * w2 * 4)
     k = 2 * radius + 1
+    if hb == 0:  # slab too large for VMEM: identical pure-JAX semantics
+        from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+        return windowed_linear_sample(volume, center, radius), (volume, center)
     out = pl.pallas_call(
         functools.partial(_lookup_fwd_kernel, radius),
         grid=(b, h // hb),
@@ -126,8 +142,16 @@ def _ws_pallas_fwd(volume, center, radius):
 def _ws_pallas_bwd(radius, res, ct):
     volume, center = res
     b, h, w1, w2 = volume.shape
-    hb = _row_block(h)
+    hb = _row_block(h, 2 * w1 * w2 * 4)
     k = 2 * radius + 1
+    if hb == 0:  # mirror the forward's pure-JAX fallback
+        from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+
+        def f(v, c):
+            return windowed_linear_sample(v, c, radius)
+
+        _, vjp = jax.vjp(f, volume, center)
+        return vjp(ct.astype(jnp.float32))
     dvol, dcoords = pl.pallas_call(
         functools.partial(_lookup_bwd_kernel, radius),
         grid=(b, h // hb),
@@ -231,9 +255,17 @@ def alt_windowed_corr_pallas(fmap1: jax.Array, fmap2: jax.Array,
 def _alt_pallas_fwd(fmap1, fmap2, center, radius):
     b, h, w1, d = fmap1.shape
     w2 = fmap2.shape[2]
-    hb = _row_block(h)
+    # resident per row: f1 (w1*d) + f2 (w2*d) + vol (w1*w2), fp32
+    hb = _row_block(h, 4 * (w1 * d + w2 * d + w1 * w2))
     k = 2 * radius + 1
     scale = 1.0 / float(d) ** 0.5
+    if hb == 0:
+        from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+        vol = jnp.einsum("bhwd,bhvd->bhwv", fmap1.astype(jnp.float32),
+                         fmap2.astype(jnp.float32),
+                         preferred_element_type=jnp.float32) * scale
+        return (windowed_linear_sample(vol, center, radius),
+                (fmap1, fmap2, center))
     out = pl.pallas_call(
         functools.partial(_alt_fwd_kernel, radius, scale),
         grid=(b, h // hb),
@@ -253,9 +285,21 @@ def _alt_pallas_bwd(radius, res, ct):
     fmap1, fmap2, center = res
     b, h, w1, d = fmap1.shape
     w2 = fmap2.shape[2]
-    hb = _row_block(h)
+    hb = _row_block(h, 4 * (2 * w1 * d + 2 * w2 * d + w1 * w2))
     k = 2 * radius + 1
     scale = 1.0 / float(d) ** 0.5
+    if hb == 0:
+        from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+
+        def f(a, b2):
+            vol = jnp.einsum("bhwd,bhvd->bhwv", a.astype(jnp.float32),
+                             b2.astype(jnp.float32),
+                             preferred_element_type=jnp.float32) * scale
+            return windowed_linear_sample(vol, center, radius)
+
+        _, vjp = jax.vjp(f, fmap1, fmap2)
+        df1, df2 = vjp(ct.astype(jnp.float32))
+        return df1, df2, None
     df1, df2 = pl.pallas_call(
         functools.partial(_alt_bwd_kernel, radius, scale),
         grid=(b, h // hb),

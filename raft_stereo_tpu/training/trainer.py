@@ -108,6 +108,12 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
             global_step += 1
 
             if global_step % validation_frequency == 0:
+                # flush the in-flight metrics first so validation scalars and
+                # the checkpoint agree on the step axis
+                if pending is not None:
+                    log.push({k: float(v) for k, v in pending.items()},
+                             lr=float(schedule(global_step - 1)))
+                    pending = None
                 ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
                                         step=global_step)
                 logger.info("saved %s", ckpt)

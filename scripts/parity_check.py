@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""End-to-end numerical parity harness vs the PyTorch reference.
+
+Builds the reference RAFTStereo (from --reference_dir, default
+/root/reference) and this framework's model with IDENTICAL weights — either a
+released ``.pth`` checkpoint or a seeded random torch init — runs both on the
+same image pairs (random, or a left/right pair from disk), and reports the
+deviation of the predicted disparities. This automates the "EPE within 1% of
+the PyTorch/CUDA baseline" acceptance check (BASELINE.json) without needing
+benchmark datasets on disk.
+
+Usage:
+  python scripts/parity_check.py                       # random weights+images
+  python scripts/parity_check.py --restore_ckpt m.pth --iters 32
+  python scripts/parity_check.py -l left.png -r right.png --restore_ckpt m.pth
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--reference_dir", default="/root/reference")
+    parser.add_argument("--restore_ckpt", default=None, help=".pth weights")
+    parser.add_argument("-l", "--left", default=None)
+    parser.add_argument("-r", "--right", default=None)
+    parser.add_argument("--iters", type=int, default=12)
+    parser.add_argument("--size", type=int, nargs=2, default=[96, 160],
+                        help="random-image H W (ignored with -l/-r)")
+    parser.add_argument("--pairs", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--tolerance", type=float, default=0.05,
+                        help="max allowed mean |disparity| deviation (px)")
+    from raft_stereo_tpu import cli
+    cli.add_model_args(parser)
+    args = parser.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # bit-stable comparison target
+    import torch
+
+    sys.path.insert(0, args.reference_dir)
+    from core.raft_stereo import RAFTStereo as TorchRAFTStereo
+
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.utils.checkpoint_convert import (
+        convert_state_dict, load_reference_checkpoint,
+        validate_against_variables)
+
+    cfg = cli.model_config(args)
+    targs = argparse.Namespace(
+        hidden_dims=list(cfg.hidden_dims), corr_implementation="reg",
+        shared_backbone=cfg.shared_backbone, corr_levels=cfg.corr_levels,
+        corr_radius=cfg.corr_radius, n_downsample=cfg.n_downsample,
+        context_norm=cfg.context_norm, slow_fast_gru=cfg.slow_fast_gru,
+        n_gru_layers=cfg.n_gru_layers, mixed_precision=False)
+    torch.manual_seed(args.seed)
+    tmodel = TorchRAFTStereo(targs)
+    if args.restore_ckpt:
+        sd = torch.load(args.restore_ckpt, map_location="cpu")
+        tmodel.load_state_dict(
+            {k.replace("module.", ""): v for k, v in sd.items()})
+    tmodel.eval()
+
+    if args.restore_ckpt:
+        converted = load_reference_checkpoint(args.restore_ckpt)
+    else:
+        converted = convert_state_dict(tmodel.state_dict())
+    model, variables = init_model(jax.random.PRNGKey(0), cfg,
+                                  (1, 64, 128, 3))
+    converted = validate_against_variables(converted, variables)
+
+    if args.left:
+        from raft_stereo_tpu.data.frame_utils import read_image
+        imgs = [(read_image(args.left)[None].astype(np.float32),
+                 read_image(args.right)[None].astype(np.float32))]
+    else:
+        rng = np.random.default_rng(args.seed)
+        h, w = args.size
+        imgs = [(rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32),
+                 rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32))
+                for _ in range(args.pairs)]
+
+    worst = 0.0
+    for i, (im1, im2) in enumerate(imgs):
+        with torch.no_grad():
+            _, t_up = tmodel(torch.from_numpy(im1.transpose(0, 3, 1, 2)),
+                             torch.from_numpy(im2.transpose(0, 3, 1, 2)),
+                             iters=args.iters, test_mode=True)
+        t_disp = -t_up.numpy()[:, 0]
+        _, j_up = model.apply(converted, im1, im2, iters=args.iters,
+                              test_mode=True)
+        j_disp = -np.asarray(j_up)[..., 0]
+        dev = np.abs(j_disp - t_disp)
+        print(f"pair {i}: mean|Δdisp| {dev.mean():.5f}px  "
+              f"max|Δdisp| {dev.max():.5f}px  "
+              f"(torch range [{t_disp.min():.2f}, {t_disp.max():.2f}])")
+        worst = max(worst, float(dev.mean()))
+
+    if worst > args.tolerance:
+        print(f"FAIL: mean deviation {worst:.5f} > {args.tolerance}")
+        return 1
+    print(f"PASS: all pairs within {args.tolerance}px mean deviation")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

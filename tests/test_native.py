@@ -1,0 +1,71 @@
+"""Native C++ data-path library vs the numpy reference implementations."""
+
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.data import frame_utils, native
+
+
+@pytest.fixture(scope="module")
+def have_native():
+    if not native.available():
+        pytest.skip("native library unavailable (no toolchain?)")
+
+
+def test_pfm_native_bit_identical(tmp_path, have_native):
+    rng = np.random.default_rng(0)
+    for shape in [(37, 53), (16, 128)]:
+        arr = rng.normal(scale=100.0, size=shape).astype(np.float32)
+        p = str(tmp_path / f"x_{shape[0]}.pfm")
+        frame_utils.write_pfm(p, arr)
+        got = native.read_pfm(p)
+        want = frame_utils._read_pfm_numpy(p)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_pfm_native_rejects_garbage(tmp_path, have_native):
+    p = str(tmp_path / "bad.pfm")
+    with open(p, "wb") as f:
+        f.write(b"NOTPFM\n1 1\n-1.0\n\x00\x00\x00\x00")
+    with pytest.raises(ValueError):
+        native.read_pfm(p)
+
+
+def test_pfm_native_truncated(tmp_path, have_native):
+    p = str(tmp_path / "trunc.pfm")
+    with open(p, "wb") as f:
+        f.write(b"Pf\n8 8\n-1.0\n")
+        f.write(b"\x00" * 16)  # far fewer than 8*8*4 bytes
+    with pytest.raises(ValueError):
+        native.read_pfm(p)
+
+
+def test_collate_matches_numpy(have_native):
+    rng = np.random.default_rng(1)
+    imgs = [rng.integers(0, 255, (24, 32, 3), dtype=np.uint8)
+            for _ in range(4)]
+    got = native.collate_u8(imgs)
+    want = np.stack(imgs).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_read_pfm_dispatch_uses_native(tmp_path, have_native):
+    """frame_utils.read_pfm returns the same array regardless of path."""
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    p = str(tmp_path / "d.pfm")
+    frame_utils.write_pfm(p, arr)
+    np.testing.assert_array_equal(frame_utils.read_pfm(p), arr)
+
+
+def test_pfm_crlf_scale_line(tmp_path, have_native):
+    """CRLF-terminated scale line must not shift the payload offset."""
+    arr = np.arange(20, dtype=np.float32).reshape(4, 5)
+    p = str(tmp_path / "crlf.pfm")
+    with open(p, "wb") as f:
+        f.write(b"Pf\n5 4\n-1.0\r\n")
+        f.write(np.flipud(arr).astype("<f4").tobytes())
+    got = native.read_pfm(p)
+    want = frame_utils._read_pfm_numpy(p)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, arr)
