@@ -81,18 +81,22 @@ int pfm_decode(const char* path, int64_t data_offset, int32_t width,
   void* mapped = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
   close(fd);
   if (mapped == MAP_FAILED) return -4;
-  const float* src =
-      reinterpret_cast<const float*>(static_cast<const char*>(mapped) +
-                                     data_offset);
+  // data_offset is rarely 4-byte aligned, so all payload access goes through
+  // byte pointers + memcpy (direct float loads would be UB / SIGBUS).
+  const char* src = static_cast<const char*>(mapped) + data_offset;
 
   for (int32_t r = 0; r < height; ++r) {
     // PFM rows run bottom-to-top; write them top-down.
-    const float* src_row = src + static_cast<int64_t>(height - 1 - r) * row_elems;
+    const char* src_row = src + static_cast<int64_t>(height - 1 - r) * row_elems * 4;
     float* dst_row = out + static_cast<int64_t>(r) * row_elems;
     if (little_endian) {
       std::memcpy(dst_row, src_row, row_elems * 4);
     } else {
-      for (int64_t i = 0; i < row_elems; ++i) dst_row[i] = bswap_float(src_row[i]);
+      for (int64_t i = 0; i < row_elems; ++i) {
+        float v;
+        std::memcpy(&v, src_row + i * 4, 4);
+        dst_row[i] = bswap_float(v);
+      }
     }
   }
   munmap(mapped, st.st_size);

@@ -89,6 +89,8 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                    help="data-parallel shards (<=0: all devices)")
     o.add_argument("--seq_parallel", type=int, default=1,
                    help="width (sequence) parallel shards")
+    o.add_argument("--grad_accum_steps", type=int, default=1,
+                   help="average grads over k micro-batches per update")
 
 
 def train_config(args: argparse.Namespace) -> TrainConfig:
@@ -116,6 +118,7 @@ def train_config(args: argparse.Namespace) -> TrainConfig:
         num_workers=args.num_workers,
         data_parallel=args.data_parallel,
         seq_parallel=args.seq_parallel,
+        grad_accum_steps=args.grad_accum_steps,
     )
 
 
@@ -185,6 +188,14 @@ def _eval_main():
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
+    # the reference enables mixed precision automatically for the kernel
+    # implementations (evaluate_stereo.py:229-231); mirror that for the
+    # pallas variants (and their *_cuda aliases)
+    if args.corr_implementation.endswith(("_cuda", "_pallas")) \
+            and not args.mixed_precision:
+        logging.getLogger(__name__).info(
+            "enabling mixed precision for %s", args.corr_implementation)
+        args.mixed_precision = True
     cfg = model_config(args)
     _, variables = load_variables(args.restore_ckpt, cfg)
     predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,

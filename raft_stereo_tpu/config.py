@@ -112,6 +112,11 @@ class TrainConfig:
     # data_parallel <= 0 means "use all available devices".
     data_parallel: int = 0
     seq_parallel: int = 1
+    # Gradient accumulation: average grads over k micro-batches before each
+    # optimizer update (optax.MultiSteps) — large effective batches on few
+    # chips. num_steps counts micro-steps; the LR schedule advances per
+    # accumulated update.
+    grad_accum_steps: int = 1
 
 
 # --- Named presets mirroring the reference's published training commands -------------

@@ -174,6 +174,10 @@ class RAFTStereo(nn.Module):
         if cfg.remat_refinement:
             remat_kwargs = {"prevent_cse": False}
             if cfg.remat_policy == "save_gru_convs":
+                # NOTE: a broader policy also saving motion/mask/flow-head
+                # conv outputs was measured to OOM the 16 GB chip at the
+                # SceneFlow train shape (~5 GB of saved slabs); the gate
+                # convs alone fit and are the biggest recompute items.
                 remat_kwargs["policy"] = \
                     jax.checkpoint_policies.save_only_these_names(
                         "gru_zr", "gru_q")

@@ -52,7 +52,9 @@ class FlowHead(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = nn.relu(Conv.make(self.hidden_dim, 3, 1, 1, self.dtype, "conv1")(x))
+        x = nn.relu(checkpoint_name(
+            Conv.make(self.hidden_dim, 3, 1, 1, self.dtype, "conv1")(x),
+            "flow_head_hidden"))
         return Conv.make(self.output_dim, 3, 1, 1, self.dtype, "conv2")(x)
 
 
@@ -137,8 +139,9 @@ class BasicMotionEncoder(nn.Module):
         cor = nn.relu(Conv.make(64, 3, 1, 1, d, "convc2")(cor))
         flo = nn.relu(Conv.make(64, 7, 1, 3, d, "convf1")(flow))
         flo = nn.relu(Conv.make(64, 3, 1, 1, d, "convf2")(flo))
-        out = nn.relu(Conv.make(128 - 2, 3, 1, 1, d, "conv")(
-            jnp.concatenate([cor, flo], axis=-1)))
+        out = nn.relu(checkpoint_name(
+            Conv.make(128 - 2, 3, 1, 1, d, "conv")(
+                jnp.concatenate([cor, flo], axis=-1)), "motion_out"))
         return jnp.concatenate([out, flow], axis=-1)
 
 
@@ -195,7 +198,8 @@ class BasicMultiUpdateBlock(nn.Module):
         delta_flow = FlowHead(256, 2, dtype=d, name="flow_head")(net[0])
 
         # scale mask to balance gradients (update.py:136-137)
-        mask = Conv.make(256, 3, 1, 1, d, "mask_conv1")(net[0])
+        mask = checkpoint_name(
+            Conv.make(256, 3, 1, 1, d, "mask_conv1")(net[0]), "mask_hidden")
         mask = Conv.make(cfg.factor ** 2 * 9, 1, 1, 0, d,
                          "mask_conv2")(nn.relu(mask))
         return tuple(net), 0.25 * mask, delta_flow

@@ -53,10 +53,10 @@ def _row_block(h: int, slab_bytes_per_row: int) -> int:
     """
     if slab_bytes_per_row > _VMEM_BUDGET_BYTES:
         return 0
-    for hb in (8, 4, 2, 1):
+    for hb in (8, 4, 2):
         if h % hb == 0 and hb * slab_bytes_per_row <= _VMEM_BUDGET_BYTES:
             return hb
-    return 1 if slab_bytes_per_row <= _VMEM_BUDGET_BYTES else 0
+    return 1
 
 
 # --------------------------------------------------------------- reg lookup

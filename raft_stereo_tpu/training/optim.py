@@ -36,10 +36,22 @@ def one_cycle_lr(peak_lr: float, total_steps: int, pct_start: float = 0.01,
 def fetch_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """AdamW + OneCycle + global-norm clip, mirroring fetch_optimizer
     (train_stereo.py:72-79). Weight decay applies to every parameter, as in
-    torch (the reference does not exclude norms/biases)."""
-    schedule = one_cycle_lr(cfg.lr, cfg.num_steps + 100)
-    return optax.chain(
+    torch (the reference does not exclude norms/biases).
+
+    ``cfg.grad_accum_steps > 1`` wraps the transform in ``optax.MultiSteps``:
+    gradients are averaged over k micro-batches per update (large effective
+    batches without the activation memory).
+    """
+    k = max(getattr(cfg, "grad_accum_steps", 1), 1)
+    # num_steps counts micro-steps; the inner schedule advances once per
+    # APPLIED update, so its horizon is the number of updates
+    n_updates = -(-cfg.num_steps // k)
+    schedule = one_cycle_lr(cfg.lr, n_updates + 100)
+    tx = optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(learning_rate=schedule, b1=0.9, b2=0.999, eps=1e-8,
                     weight_decay=cfg.wdecay),
     )
+    if k > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=k)
+    return tx

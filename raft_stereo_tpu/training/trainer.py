@@ -80,11 +80,14 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         state = _restore(cfg.restore_ckpt, state, model_cfg, variables)
 
     loader = fetch_dataloader(cfg)
+    accum_k = max(cfg.grad_accum_steps, 1)
     if int(state.step):
         # reposition the data stream's epoch to match the restored step
         # (intra-epoch order is not restored; see training/checkpoint.py)
         loader.epoch = int(state.step) // max(len(loader), 1)
-    schedule = one_cycle_lr(cfg.lr, cfg.num_steps + 100)
+    # mirror fetch_optimizer's horizon: the schedule advances per APPLIED
+    # update (num_steps counts micro-steps under gradient accumulation)
+    schedule = one_cycle_lr(cfg.lr, -(-cfg.num_steps // accum_k) + 100)
 
     with mesh:
         state = jax.device_put(state, replicated(mesh))
@@ -102,7 +105,7 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
             state, metrics = step_fn(state, placed)
             if pending is not None:
                 log.push({k: float(v) for k, v in pending.items()},
-                         lr=float(schedule(global_step - 1)))
+                         lr=float(schedule((global_step - 1) // accum_k)))
             pending = metrics
             imgs_done += cfg.batch_size
             global_step += 1
@@ -112,7 +115,7 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                 # the checkpoint agree on the step axis
                 if pending is not None:
                     log.push({k: float(v) for k, v in pending.items()},
-                             lr=float(schedule(global_step - 1)))
+                             lr=float(schedule((global_step - 1) // accum_k)))
                     pending = None
                 ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
                                         step=global_step)
@@ -135,7 +138,7 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
 
         if pending is not None:
             log.push({k: float(v) for k, v in pending.items()},
-                     lr=float(schedule(global_step - 1)))
+                     lr=float(schedule((global_step - 1) // accum_k)))
         final = save_train_state(cfg.ckpt_dir, cfg.name, state)
         log.close()
     logger.info("training done: %s", final)

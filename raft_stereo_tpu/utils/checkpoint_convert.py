@@ -145,6 +145,106 @@ def load_reference_checkpoint(path: str) -> Dict[str, Dict]:
     return convert_state_dict(state)
 
 
+def convert_to_torch_state_dict(variables: Dict, *,
+                                data_parallel_prefix: bool = True) -> Dict:
+    """Flax variables -> a reference-compatible torch state_dict (the reverse
+    of :func:`convert_state_dict`): train here, evaluate/finetune with the
+    reference's own tooling.
+
+    Keys carry the ``module.`` DataParallel prefix by default, matching how
+    the reference saves and strict-loads checkpoints (train_stereo.py:142-147).
+    Conv kernels transpose back ``(kH, kW, I, O) -> (O, I, kH, kW)``.
+    """
+    import torch
+
+    def flatten(tree) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+
+        def walk(node, flax_path):
+            for key, val in node.items():
+                if isinstance(val, Mapping):
+                    walk(val, flax_path + (key,))
+                else:
+                    out[".".join(flax_path + (key,))] = val
+
+        walk(tree, ())
+        return out
+
+    params_flat = flatten(variables.get("params", {}))
+    stats_flat = flatten(variables.get("batch_stats", {}))
+
+    def to_torch_key(flax_key: str, leaf: str) -> str:
+        parts = flax_key.split(".")
+        out = []
+        i = 0
+        while i < len(parts) - 1:
+            p = parts[i]
+            if p == "trunk":
+                pass  # flattened into the encoder in torch
+            elif re.fullmatch(r"layer[1-5]_[01]", p):
+                lvl, j = p.split("_")
+                out += [lvl, j]
+            elif re.fullmatch(r"outputs(08|16|32)_\d+_(res|conv)", p):
+                scale, idx, kind = re.fullmatch(
+                    r"outputs(08|16|32)_(\d+)_(res|conv)", p).groups()
+                if scale == "32":
+                    out += [f"outputs32", idx]
+                else:
+                    out += [f"outputs{scale}", idx, "0" if kind == "res" else "1"]
+            elif p == "down_conv":
+                out += ["downsample", "0"]
+            elif p == "refinement":
+                pass  # scan wrapper; torch has no analog level
+            elif p == "mask_conv1":
+                out += ["mask", "0"]
+            elif p == "mask_conv2":
+                out += ["mask", "2"]
+            elif p == "conv2_res":
+                out += ["conv2", "0"]
+            elif p == "conv2_out":
+                out += ["conv2", "1"]
+            elif re.fullmatch(r"context_zqr_convs_(\d+)", p):
+                out += ["context_zqr_convs", p.rsplit("_", 1)[1]]
+            else:
+                out.append(p)
+            i += 1
+        return ".".join(out + [leaf])
+
+    state: Dict[str, "torch.Tensor"] = {}
+    for key, val in params_flat.items():
+        leaf = key.rsplit(".", 1)[1]
+        arr = np.asarray(val, np.float32)
+        if leaf == "kernel":
+            state[to_torch_key(key, "weight")] = torch.from_numpy(
+                arr.transpose(3, 2, 0, 1).copy())
+        elif leaf == "scale":
+            state[to_torch_key(key, "weight")] = torch.from_numpy(arr.copy())
+        else:  # bias
+            state[to_torch_key(key, "bias")] = torch.from_numpy(arr.copy())
+    for key, val in stats_flat.items():
+        leaf = key.rsplit(".", 1)[1]
+        arr = np.asarray(val, np.float32)
+        torch_leaf = "running_mean" if leaf == "mean" else "running_var"
+        state[to_torch_key(key, torch_leaf)] = torch.from_numpy(arr.copy())
+        nbt = to_torch_key(key, "num_batches_tracked")
+        state.setdefault(nbt, torch.zeros((), dtype=torch.long))
+
+    # The reference's ResidualBlock registers norm3 twice — standalone AND as
+    # downsample[1] (extractor.py:44-45) — so state_dict() emits both key
+    # spellings for the same tensors; strict loading needs the duplicates.
+    for key in list(state):
+        if key.endswith("downsample.0.weight"):
+            block = key[: -len("downsample.0.weight")]
+            for k2 in list(state):
+                if k2.startswith(block + "norm3."):
+                    dup = block + "downsample.1." + k2[len(block + "norm3."):]
+                    state[dup] = state[k2]
+
+    if data_parallel_prefix:
+        state = {f"module.{k}": v for k, v in state.items()}
+    return state
+
+
 def validate_against_variables(converted: Dict, variables: Dict, *,
                                allow_unused: bool = True) -> Dict[str, Dict]:
     """Check the converted tree against a model init; return the usable tree.

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Inference FPS benchmark: KITTI-sized frames, default and realtime presets.
+
+The reference reports KITTI FPS at eval time after a warmup
+(evaluate_stereo.py:77-81,105-107) and documents a "realtime" configuration
+(README.md:105). This measures both on synthetic KITTI-resolution pairs
+(375x1242, padded to /32), with honest host-fetch synchronization per frame.
+
+  python scripts/bench_inference.py            # both presets
+  python scripts/bench_inference.py --preset realtime --iters 7
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", choices=["default", "realtime", "both"],
+                        default="both")
+    parser.add_argument("--iters", type=int, default=None,
+                        help="refinement iterations (default: 32 / 7)")
+    parser.add_argument("--size", type=int, nargs=2, default=[375, 1242])
+    parser.add_argument("--frames", type=int, default=12)
+    args = parser.parse_args()
+
+    import jax
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, realtime_config
+    from raft_stereo_tpu.inference import StereoPredictor
+    from raft_stereo_tpu.models import init_model
+
+    presets = {
+        "default": (RAFTStereoConfig(mixed_precision=True), 32),
+        "realtime": (realtime_config(), 7),
+    }
+    chosen = ["default", "realtime"] if args.preset == "both" else [args.preset]
+
+    h, w = args.size
+    rng = np.random.default_rng(0)
+    left = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+    right = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+
+    for name in chosen:
+        cfg, default_iters = presets[name]
+        iters = args.iters or default_iters
+        _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 128, 3))
+        predictor = StereoPredictor(cfg, variables, valid_iters=iters)
+        predictor(left, right)  # compile + warmup
+        predictor(left, right)
+        t0 = time.perf_counter()
+        for _ in range(args.frames):
+            out = predictor(left, right)  # returns host numpy: honest sync
+        dt = (time.perf_counter() - t0) / args.frames
+        print(f"{name:9s} iters={iters:2d} {h}x{w}: "
+              f"{dt * 1000:7.1f} ms/frame = {1.0 / dt:6.2f} FPS "
+              f"(platform {jax.devices()[0].platform})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

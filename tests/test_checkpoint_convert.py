@@ -119,3 +119,30 @@ def test_strict_validation_catches_mismatch(torch_reference):
     _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 96, 3))
     with pytest.raises(ValueError, match="missing"):
         validate_against_variables(converted, variables)
+
+
+@requires_reference
+def test_reverse_conversion_strict_roundtrip(torch_reference):
+    """flax -> torch state_dict loads strict=True and reproduces the model."""
+    import torch
+
+    from raft_stereo_tpu.utils.checkpoint_convert import (
+        convert_to_torch_state_dict)
+
+    cfg = RAFTStereoConfig()
+    tmodel = _torch_reference_model(cfg, seed=11)
+    converted = convert_state_dict(tmodel.state_dict())
+
+    back = convert_to_torch_state_dict(converted, data_parallel_prefix=False)
+    tmodel2 = _torch_reference_model(cfg, seed=99)  # different init
+    tmodel2.load_state_dict(back, strict=True)
+
+    rng = np.random.default_rng(13)
+    img1 = rng.uniform(0, 255, (1, 48, 96, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, (1, 48, 96, 3)).astype(np.float32)
+    t1 = torch.from_numpy(img1.transpose(0, 3, 1, 2))
+    t2 = torch.from_numpy(img2.transpose(0, 3, 1, 2))
+    with torch.no_grad():
+        _, up_a = tmodel(t1, t2, iters=4, test_mode=True)
+        _, up_b = tmodel2(t1, t2, iters=4, test_mode=True)
+    np.testing.assert_allclose(up_b.numpy(), up_a.numpy(), atol=1e-6)

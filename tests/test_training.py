@@ -204,3 +204,34 @@ def test_fused_loss_matches_stacked():
     for k in metrics_a:
         np.testing.assert_allclose(float(metrics_a[k]), float(metrics_b[k]),
                                    rtol=1e-6, err_msg=k)
+
+
+def test_grad_accumulation_updates_every_k():
+    """optax.MultiSteps wiring: params move only on each k-th micro-step."""
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+    cfg = RAFTStereoConfig()
+    tcfg = TrainConfig(num_steps=10, batch_size=1, grad_accum_steps=2)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-4, 0, (1, 32, 48, 1)), jnp.float32),
+        "valid": jnp.ones((1, 32, 48), jnp.float32),
+    }
+    step = make_train_step(model, tx, train_iters=2)
+    leaf0 = np.asarray(state.params["fnet"]["conv2"]["kernel"])
+    state, _ = step(state, batch)
+    leaf1 = np.asarray(state.params["fnet"]["conv2"]["kernel"])
+    np.testing.assert_array_equal(leaf1, leaf0)  # accumulating, no update yet
+    state, _ = step(state, batch)
+    leaf2 = np.asarray(state.params["fnet"]["conv2"]["kernel"])
+    assert np.abs(leaf2 - leaf0).max() > 0  # k-th micro-step applied
