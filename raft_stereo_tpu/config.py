@@ -59,9 +59,11 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
-        if self.remat_policy not in (None, "save_gru_convs"):
+        if self.remat_policy not in (None, "save_gru_convs", "save_hot",
+                                     "save_corr"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
-                             "expected None or 'save_gru_convs'")
+                             "expected None, 'save_gru_convs', 'save_hot' "
+                             "or 'save_corr'")
         if len(self.hidden_dims) != 3 or self.hidden_dims[0] != self.hidden_dims[2]:
             # The reference wires context conv i (sized hidden_dims[i]) into the
             # GRU at level i whose hidden size is hidden_dims[2-i]

@@ -18,6 +18,7 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
@@ -35,20 +36,41 @@ class RefinementStep(nn.Module):
     Owns the update block's params (broadcast across scan iterations). The
     epipolar constraint zeroes the y-component of every delta
     (raft_stereo.py:119-120), so lookups stay on integer rows.
+
+    Carry layout depends on the (static) mode, because under remat the scan
+    saves every iteration's carry as backward residuals — dead carry slots
+    are pure HBM waste at ~22x their size:
+
+    * train stacked: ``(net, coords1)`` — the upsample mask is consumed
+      inside the iteration and never crosses iterations (measured: carrying
+      the (B, H/f, W/f, 9*f^2) fp32 mask cost ~1.5 GB of residuals).
+    * train fused-loss: ``(net, coords1, flow_up)`` — the final full-res
+      prediction rides the carry (needed after the scan for metrics).
+    * test: ``(net, coords1, mask)`` — the final mask feeds the one
+      deferred upsample (raft_stereo.py:126-136); no backward pass exists.
     """
 
     cfg: RAFTStereoConfig
     test_mode: bool = False
+    fused: bool = False
     dtype: Optional[Dtype] = None
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
                  gt_and_mask):
-        net, coords1, _ = carry
+        net, coords1 = carry[0], carry[1]
         coords1 = jax.lax.stop_gradient(coords1)
 
         corr = corr_lookup(corr_state, coords1)
         flow = coords1 - coords0
+
+        # Tag the (compute-dtype) lookup output for selective-remat policies:
+        # the pyramid lookup is by far the costliest recompute per byte saved
+        # (a full pass over the volume pyramid vs a (B, H, W,
+        # num_levels*(2r+1)) slab). Tagged unconditionally — checkpoint_name
+        # is identity when no policy saves it.
+        dt0 = self.dtype
+        corr = checkpoint_name(corr.astype(dt0) if dt0 else corr, "corr_feats")
 
         cfg = self.cfg
         dt = self.dtype
@@ -60,7 +82,7 @@ class RefinementStep(nn.Module):
             net = block(net, inp_list, iter32=cfg.n_gru_layers == 3,
                         iter16=True, iter08=False, update=False)
         net, mask, delta_flow = block(
-            net, inp_list, corr.astype(dt) if dt else corr, flow.astype(dt) if dt else flow,
+            net, inp_list, corr, flow.astype(dt) if dt else flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2)
 
         # stereo: project the update onto the epipolar line
@@ -68,14 +90,13 @@ class RefinementStep(nn.Module):
         delta_flow = delta_flow.at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow
 
-        new_carry = (net, coords1, mask.astype(jnp.float32))
         if self.test_mode:
             # intermediate upsampling skipped (raft_stereo.py:126-127)
-            return new_carry, None
+            return (net, coords1, mask.astype(jnp.float32)), None
         flow_up = upsample_disparity_convex(coords1 - coords0,
                                             mask.astype(jnp.float32),
                                             cfg.factor)
-        if gt_and_mask is not None:
+        if self.fused:
             # fused-loss path: reduce this iteration's masked L1 to a scalar
             # INSIDE the scan, so the (iters, B, H, W, 1) full-resolution
             # prediction stack (~0.7 GB at train shape) is never written to
@@ -83,8 +104,8 @@ class RefinementStep(nn.Module):
             flow_gt, loss_mask = gt_and_mask
             err = jnp.abs(flow_up.astype(jnp.float32) - flow_gt)
             err_sum = jnp.sum(jnp.where(loss_mask > 0, err, 0.0))
-            return new_carry, err_sum
-        return new_carry, flow_up
+            return (net, coords1, flow_up), err_sum
+        return (net, coords1), flow_up
 
 
 class RAFTStereo(nn.Module):
@@ -154,7 +175,8 @@ class RAFTStereo(nn.Module):
 
         corr_state = init_corr(cfg.corr_implementation, fmap1, fmap2,
                                num_levels=cfg.corr_levels,
-                               radius=cfg.corr_radius)
+                               radius=cfg.corr_radius,
+                               storage_dtype=dt)
 
         b, h, w, _ = net_list[0].shape
         coords0 = coords_grid(b, h, w)
@@ -162,9 +184,17 @@ class RAFTStereo(nn.Module):
         if flow_init is not None:
             coords1 = coords1 + flow_init
 
-        mask_ch = 9 * cfg.factor ** 2
-        carry = (tuple(net_list), coords1,
-                 jnp.zeros((b, h, w, mask_ch), jnp.float32))
+        fused = flow_gt is not None
+        if test_mode:
+            mask_ch = 9 * cfg.factor ** 2
+            carry = (tuple(net_list), coords1,
+                     jnp.zeros((b, h, w, mask_ch), jnp.float32))
+        elif fused:
+            carry = (tuple(net_list), coords1,
+                     jnp.zeros((b, h * cfg.factor, w * cfg.factor, 1),
+                               jnp.float32))
+        else:
+            carry = (tuple(net_list), coords1)
 
         # Rematerialize each refinement iteration: without this, the scan
         # stores every iteration's GRU/conv activations for the backward pass
@@ -174,13 +204,24 @@ class RAFTStereo(nn.Module):
         if cfg.remat_refinement:
             remat_kwargs = {"prevent_cse": False}
             if cfg.remat_policy == "save_gru_convs":
-                # NOTE: a broader policy also saving motion/mask/flow-head
-                # conv outputs was measured to OOM the 16 GB chip at the
-                # SceneFlow train shape (~5 GB of saved slabs); the gate
-                # convs alone fit and are the biggest recompute items.
                 remat_kwargs["policy"] = \
                     jax.checkpoint_policies.save_only_these_names(
                         "gru_zr", "gru_q")
+            elif cfg.remat_policy == "save_hot":
+                # Knapsack-chosen save set (~91 MB/iter bf16): the corr
+                # lookup output (costliest recompute per byte — a full
+                # volume-pyramid pass) plus the fused GRU gate convs.
+                # Broader sets (adding the motion-encoder convs) overflow
+                # a 16 GB chip at the SceneFlow train shape and fail
+                # compilation; flow_head/mask hidden convs recompute at
+                # near-peak MXU rates and stay remat'd.
+                remat_kwargs["policy"] = \
+                    jax.checkpoint_policies.save_only_these_names(
+                        "corr_feats", "gru_zr", "gru_q")
+            elif cfg.remat_policy == "save_corr":
+                remat_kwargs["policy"] = \
+                    jax.checkpoint_policies.save_only_these_names(
+                        "corr_feats")
             body = nn.remat(RefinementStep, **remat_kwargs)
         else:
             body = RefinementStep
@@ -191,23 +232,21 @@ class RAFTStereo(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
-        )(cfg, test_mode, dt, name="refinement")
+        )(cfg, test_mode, fused, dt, name="refinement")
         gt_and_mask = None
-        if flow_gt is not None:
+        if fused:
             gt_and_mask = (flow_gt.astype(jnp.float32),
                            loss_mask.astype(jnp.float32))
         carry, flow_predictions = step(carry, corr_state, tuple(inp_list),
                                        coords0, gt_and_mask)
-        net_list, coords1, mask = carry
 
         if test_mode:
+            net_list, coords1, mask = carry
             flow_up = upsample_disparity_convex(coords1 - coords0, mask,
                                                 cfg.factor)
             return coords1 - coords0, flow_up
-        if gt_and_mask is not None:
-            flow_up = upsample_disparity_convex(coords1 - coords0, mask,
-                                                cfg.factor)
-            return flow_predictions, flow_up
+        if fused:
+            return flow_predictions, carry[2]
         return flow_predictions
 
 

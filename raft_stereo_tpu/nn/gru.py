@@ -135,10 +135,14 @@ class BasicMotionEncoder(nn.Module):
     @nn.compact
     def __call__(self, flow, corr):
         d = self.dtype
-        cor = nn.relu(Conv.make(64, 1, 1, 0, d, "convc1")(corr))
-        cor = nn.relu(Conv.make(64, 3, 1, 1, d, "convc2")(cor))
-        flo = nn.relu(Conv.make(64, 7, 1, 3, d, "convf1")(flow))
-        flo = nn.relu(Conv.make(64, 3, 1, 1, d, "convf2")(flo))
+        cor = nn.relu(checkpoint_name(
+            Conv.make(64, 1, 1, 0, d, "convc1")(corr), "motion_c1"))
+        cor = nn.relu(checkpoint_name(
+            Conv.make(64, 3, 1, 1, d, "convc2")(cor), "motion_c2"))
+        flo = nn.relu(checkpoint_name(
+            Conv.make(64, 7, 1, 3, d, "convf1")(flow), "motion_f1"))
+        flo = nn.relu(checkpoint_name(
+            Conv.make(64, 3, 1, 1, d, "convf2")(flo), "motion_f2"))
         out = nn.relu(checkpoint_name(
             Conv.make(128 - 2, 3, 1, 1, d, "conv")(
                 jnp.concatenate([cor, flo], axis=-1)), "motion_out"))

@@ -55,18 +55,27 @@ def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
     return corr / jnp.sqrt(jnp.float32(d))
 
 
-def _build_reg(fmap1, fmap2, num_levels, radius) -> CorrState:
+def _build_reg(fmap1, fmap2, num_levels, radius,
+               storage_dtype=None) -> CorrState:
     volume = all_pairs_correlation(fmap1.astype(jnp.float32),
                                    fmap2.astype(jnp.float32))
+    if storage_dtype is not None:
+        # bf16 volume storage halves the HBM footprint and the lookup's
+        # bandwidth; taps are blended in fp32 after the read. Precedent: the
+        # reference's reg_cuda path runs the whole lookup in fp16
+        # (sampler_kernel.cu:126, evaluate_stereo.py:229-231).
+        volume = volume.astype(storage_dtype)
     levels = [volume]
     for _ in range(num_levels - 1):
         levels.append(pool_last_axis2(levels[-1]))
     return CorrState(levels=tuple(levels), fmap1=None, impl="reg", radius=radius)
 
 
-def _build_alt(fmap1, fmap2, num_levels, radius) -> CorrState:
-    fmap1 = fmap1.astype(jnp.float32)
-    fmap2 = fmap2.astype(jnp.float32)
+def _build_alt(fmap1, fmap2, num_levels, radius,
+               storage_dtype=None) -> CorrState:
+    dt = storage_dtype or jnp.float32
+    fmap1 = fmap1.astype(dt)
+    fmap2 = fmap2.astype(dt)
     levels = [fmap2]
     for _ in range(num_levels - 1):
         levels.append(pool_w2(levels[-1]))
@@ -129,14 +138,22 @@ register_corr("alt", _build_alt, _lookup_alt)
 
 
 def init_corr(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,
-              num_levels: int = 4, radius: int = 4) -> CorrState:
-    """Build correlation state from NHWC feature maps ``(B, H, W, D)``."""
+              num_levels: int = 4, radius: int = 4,
+              storage_dtype=None) -> CorrState:
+    """Build correlation state from NHWC feature maps ``(B, H, W, D)``.
+
+    ``storage_dtype`` (e.g. ``jnp.bfloat16``) selects reduced-precision
+    storage for the volume/feature pyramid; ``None`` keeps fp32 (the
+    reference's default for reg/alt, core/raft_stereo.py:92-95). Lookup
+    accumulation is fp32 either way.
+    """
     if impl not in _BUILDERS and impl.endswith("_pallas"):
         _maybe_register_pallas()
     if impl not in _BUILDERS:
         raise ValueError(f"unknown corr implementation {impl!r}; "
                          f"registered: {sorted(_BUILDERS)}")
-    return _BUILDERS[impl](fmap1, fmap2, num_levels, radius)
+    return _BUILDERS[impl](fmap1, fmap2, num_levels, radius,
+                           storage_dtype=storage_dtype)
 
 
 def corr_lookup(state: CorrState, coords: jax.Array) -> jax.Array:

@@ -60,20 +60,71 @@ def _row_block(h: int, slab_bytes_per_row: int) -> int:
 
 
 # --------------------------------------------------------------- reg lookup
+#
+# Window extraction is a barrel shifter: rotate each (VMEM-resident) volume
+# row left by ``base`` lanes with log2(W2p) STATIC rotates, each kept or
+# skipped per row by a select on one bit of ``base`` — after which the 2r+2
+# window taps sit at lanes [0, 2r+2). Static lane rotates + per-sublane
+# selects are native VPU ops; this replaces the 2r+2 full-width masked
+# reductions (one per tap, each a cross-lane reduce) the pure-JAX
+# formulation costs, and does no gather at all. The same trick inverts for
+# the backward scatter (rotate right by ``base``).
+
+
+def _num_bits(n: int) -> int:
+    return max(1, (n - 1).bit_length())
+
+
+def _rotate_left_by(v, amount, axis_size):
+    """Barrel rotate: ``v[..., i] <- v[..., (i + amount) % axis_size]``.
+
+    ``v``: (..., W); ``amount``: (...,) int32 in [0, axis_size). Static
+    rotates selected per row by the bits of ``amount``.
+    """
+    for k in range(_num_bits(axis_size)):
+        s = (1 << k) % axis_size
+        rolled = jnp.concatenate([v[..., s:], v[..., :s]], axis=-1)
+        bit = ((amount >> k) & 1)[..., None]
+        v = jnp.where(bit == 1, rolled, v)
+    return v
+
+
+def _extract_window(vol, base, radius):
+    """Taps ``g[..., j] = vol[..., base + j]`` for j in [0, 2r+2), zero
+    outside [0, W2). ``vol`` (..., W2) fp32, ``base`` (...,) int32."""
+    w2 = vol.shape[-1]
+    k = 2 * radius + 1
+    amount = jax.lax.rem(jax.lax.rem(base, w2) + w2, w2)
+    rotated = _rotate_left_by(vol, amount, w2)
+    g = rotated[..., :k + 1]
+    tap_idx = base[..., None] + jax.lax.broadcasted_iota(
+        jnp.int32, base.shape + (k + 1,), base.ndim)
+    return jnp.where((tap_idx >= 0) & (tap_idx < w2), g, 0.0)
+
+
+def _scatter_window(dg, base, radius, w2):
+    """Inverse of :func:`_extract_window`: place taps ``dg[..., j]`` at
+    ``out[..., base + j]`` (taps landing outside [0, w2) are dropped).
+    ``dg`` (..., 2r+2), ``base`` (...,) int32 -> (..., w2) fp32."""
+    k = 2 * radius + 1
+    tap_idx = base[..., None] + jax.lax.broadcasted_iota(
+        jnp.int32, base.shape + (k + 1,), base.ndim)
+    dg = jnp.where((tap_idx >= 0) & (tap_idx < w2), dg, 0.0)
+    dg_wide = jnp.pad(dg, [(0, 0)] * (dg.ndim - 1) + [(0, w2 - (k + 1))])
+    amount = jax.lax.rem(jax.lax.rem(base, w2) + w2, w2)
+    inv = jax.lax.rem(w2 - amount, w2)
+    return _rotate_left_by(dg_wide, inv, w2)
+
 
 def _lookup_fwd_kernel(radius, coords_ref, vol_ref, out_ref):
     c = coords_ref[...]                      # (Hb, W1)
     vol = vol_ref[...].astype(jnp.float32)   # (Hb, W1, W2)
     k = 2 * radius + 1
-    w2 = vol.shape[-1]
 
     base_f = jnp.floor(c)
     frac = (c - base_f)[..., None]
     base = base_f.astype(jnp.int32) - radius
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
-    taps = [jnp.sum(jnp.where(idx == j, vol, 0.0), axis=-1)
-            for j in range(k + 1)]
-    g = jnp.stack(taps, axis=-1)             # (Hb, W1, 2r+2)
+    g = _extract_window(vol, base, radius)   # (Hb, W1, 2r+2)
     out_ref[...] = (1.0 - frac) * g[..., :k] + frac * g[..., 1:]
 
 
@@ -88,21 +139,15 @@ def _lookup_bwd_kernel(radius, coords_ref, vol_ref, ct_ref, dvol_ref,
     base_f = jnp.floor(c)
     frac = (c - base_f)[..., None]
     base = base_f.astype(jnp.int32) - radius
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
 
     # dg_j = (1-f)*ct_j + f*ct_{j-1}, j in [0, 2r+1]
     zeros = jnp.zeros_like(ct[..., :1])
     dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
           + jnp.concatenate([zeros, frac * ct], axis=-1))
-    dvol = jnp.zeros_like(vol)
-    for j in range(k + 1):
-        dvol = dvol + jnp.where(idx == j, dg[..., j:j + 1], 0.0)
-    dvol_ref[...] = dvol
+    dvol_ref[...] = _scatter_window(dg, base, radius, w2)
 
-    # g taps, for the coords gradient through frac
-    taps = [jnp.sum(jnp.where(idx == j, vol, 0.0), axis=-1)
-            for j in range(k + 1)]
-    g = jnp.stack(taps, axis=-1)
+    # window taps again, for the coords gradient through frac
+    g = _extract_window(vol, base, radius)
     dcoords_ref[...] = jnp.sum(ct * (g[..., 1:] - g[..., :k]), axis=-1)
 
 
@@ -122,7 +167,8 @@ def _ws_pallas_fwd(volume, center, radius):
     # fwd holds vol + out; bwd additionally dvol — budget on 2x the vol slab
     hb = _row_block(h, 2 * w1 * w2 * 4)
     k = 2 * radius + 1
-    if hb == 0:  # slab too large for VMEM: identical pure-JAX semantics
+    if hb == 0 or w2 <= k + 1:  # slab too large for VMEM (or degenerate
+        # window): identical pure-JAX semantics
         from raft_stereo_tpu.ops.sampler import windowed_linear_sample
         return windowed_linear_sample(volume, center, radius), (volume, center)
     out = pl.pallas_call(
@@ -144,7 +190,7 @@ def _ws_pallas_bwd(radius, res, ct):
     b, h, w1, w2 = volume.shape
     hb = _row_block(h, 2 * w1 * w2 * 4)
     k = 2 * radius + 1
-    if hb == 0:  # mirror the forward's pure-JAX fallback
+    if hb == 0 or w2 <= k + 1:  # mirror the forward's pure-JAX fallback
         from raft_stereo_tpu.ops.sampler import windowed_linear_sample
 
         def f(v, c):
@@ -183,7 +229,6 @@ def _alt_fwd_kernel(radius, scale, coords_ref, f1_ref, f2_ref, out_ref):
     f1 = f1_ref[0]                               # (Hb, W1, D)
     f2 = f2_ref[0]                               # (Hb, W2, D)
     k = 2 * radius + 1
-    w2 = f2.shape[1]
 
     # per-row correlation slab on the MXU; never leaves VMEM
     vol = jax.lax.dot_general(
@@ -193,10 +238,7 @@ def _alt_fwd_kernel(radius, scale, coords_ref, f1_ref, f2_ref, out_ref):
     base_f = jnp.floor(c)
     frac = (c - base_f)[..., None]
     base = base_f.astype(jnp.int32) - radius
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
-    taps = [jnp.sum(jnp.where(idx == j, vol, 0.0), axis=-1)
-            for j in range(k + 1)]
-    g = jnp.stack(taps, axis=-1)
+    g = _extract_window(vol, base, radius)
     out_ref[0] = (1.0 - frac) * g[..., :k] + frac * g[..., 1:]
 
 
@@ -212,16 +254,11 @@ def _alt_bwd_kernel(radius, scale, coords_ref, f1_ref, f2_ref, ct_ref,
     base_f = jnp.floor(c)
     frac = (c - base_f)[..., None]
     base = base_f.astype(jnp.int32) - radius
-    idx = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w2), 2) - base[..., None]
 
     zeros = jnp.zeros_like(ct[..., :1])
     dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
           + jnp.concatenate([zeros, frac * ct], axis=-1))
-    dvol = jnp.zeros((f1.shape[0], f1.shape[1], w2), jnp.float32)
-    
-    for j in range(k + 1):
-        dvol = dvol + jnp.where(idx == j, dg[..., j:j + 1], 0.0)
-    dvol = dvol * scale
+    dvol = _scatter_window(dg, base, radius, w2) * scale
 
     # dvol: (Hb, W1, W2); f2: (Hb, W2, D) -> df1 (Hb, W1, D)
     df1_ref[0] = jax.lax.dot_general(
@@ -259,7 +296,7 @@ def _alt_pallas_fwd(fmap1, fmap2, center, radius):
     hb = _row_block(h, 4 * (w1 * d + w2 * d + w1 * w2))
     k = 2 * radius + 1
     scale = 1.0 / float(d) ** 0.5
-    if hb == 0:
+    if hb == 0 or w2 <= k + 1:
         from raft_stereo_tpu.ops.sampler import windowed_linear_sample
         vol = jnp.einsum("bhwd,bhvd->bhwv", fmap1.astype(jnp.float32),
                          fmap2.astype(jnp.float32),
@@ -288,7 +325,7 @@ def _alt_pallas_bwd(radius, res, ct):
     hb = _row_block(h, 4 * (2 * w1 * d + 2 * w2 * d + w1 * w2))
     k = 2 * radius + 1
     scale = 1.0 / float(d) ** 0.5
-    if hb == 0:
+    if hb == 0 or w2 <= k + 1:
         from raft_stereo_tpu.ops.sampler import windowed_linear_sample
 
         def f(a, b2):

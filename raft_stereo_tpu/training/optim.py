@@ -33,6 +33,18 @@ def one_cycle_lr(peak_lr: float, total_steps: int, pct_start: float = 0.01,
     return optax.join_schedules([warmup, anneal], [warmup_steps])
 
 
+def fetch_schedule(cfg: TrainConfig):
+    """The LR schedule ``fetch_optimizer`` applies — shared with the trainer's
+    logging path so the logged lr can never desync from the applied lr.
+
+    ``cfg.num_steps`` counts micro-steps; the schedule advances once per
+    APPLIED update, so its horizon is the number of updates.
+    """
+    k = max(getattr(cfg, "grad_accum_steps", 1), 1)
+    n_updates = -(-cfg.num_steps // k)
+    return one_cycle_lr(cfg.lr, n_updates + 100)
+
+
 def fetch_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     """AdamW + OneCycle + global-norm clip, mirroring fetch_optimizer
     (train_stereo.py:72-79). Weight decay applies to every parameter, as in
@@ -43,10 +55,7 @@ def fetch_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     batches without the activation memory).
     """
     k = max(getattr(cfg, "grad_accum_steps", 1), 1)
-    # num_steps counts micro-steps; the inner schedule advances once per
-    # APPLIED update, so its horizon is the number of updates
-    n_updates = -(-cfg.num_steps // k)
-    schedule = one_cycle_lr(cfg.lr, n_updates + 100)
+    schedule = fetch_schedule(cfg)
     tx = optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(learning_rate=schedule, b1=0.9, b2=0.999, eps=1e-8,

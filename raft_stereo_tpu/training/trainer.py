@@ -31,7 +31,7 @@ from raft_stereo_tpu.parallel.mesh import make_mesh, replicated, shard_batch
 from raft_stereo_tpu.training.checkpoint import (restore_train_state,
                                                  save_train_state)
 from raft_stereo_tpu.training.logger import Logger
-from raft_stereo_tpu.training.optim import fetch_optimizer, one_cycle_lr
+from raft_stereo_tpu.training.optim import fetch_optimizer, fetch_schedule
 from raft_stereo_tpu.training.state import TrainState
 
 logger = logging.getLogger(__name__)
@@ -85,9 +85,8 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         # reposition the data stream's epoch to match the restored step
         # (intra-epoch order is not restored; see training/checkpoint.py)
         loader.epoch = int(state.step) // max(len(loader), 1)
-    # mirror fetch_optimizer's horizon: the schedule advances per APPLIED
-    # update (num_steps counts micro-steps under gradient accumulation)
-    schedule = one_cycle_lr(cfg.lr, -(-cfg.num_steps // accum_k) + 100)
+    # the exact schedule fetch_optimizer applies (shared, cannot desync)
+    schedule = fetch_schedule(cfg)
 
     with mesh:
         state = jax.device_put(state, replicated(mesh))

@@ -1,0 +1,141 @@
+"""Perf sweep on real TPU: time train-step variants to find throughput headroom.
+
+Times the SceneFlow-recipe training step (batch 8, 22 iters, 320x720) across
+corr implementations and remat policies, plus forward-only and iteration-count
+scaling to split per-iteration cost from fixed cost. Prints one line per
+variant: pairs/sec/chip and ms/step.
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+
+def make_batch(rng, batch, h, w):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "image1": jax.random.uniform(k1, (batch, h, w, 3), jnp.float32) * 255,
+        "image2": jax.random.uniform(k2, (batch, h, w, 3), jnp.float32) * 255,
+        "flow": -jax.random.uniform(k3, (batch, h, w, 1), jnp.float32) * 50,
+        "valid": jnp.ones((batch, h, w), jnp.float32),
+    }
+
+
+# NOTE: on tunneled TPU devices (axon), block_until_ready has been observed
+# to return before queued executions finish (see bench.py); a host transfer
+# of an executable output is the only reliable synchronization point.
+
+def time_step(fn, state, batch, steps=4):
+    state, m = fn(state, batch)  # compile + warmup
+    float(m["loss"])
+    state, m = fn(state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    prev = None
+    for _ in range(steps):
+        state, m = fn(state, batch)
+        if prev is not None:
+            float(prev["loss"])
+        prev = m
+    float(prev["loss"])
+    return (time.perf_counter() - t0) / steps
+
+
+def time_fwd(model, variables, batch, iters, steps=4):
+    @jax.jit
+    def fwd(v, b):
+        preds = model.apply(v, b["image1"], b["image2"], iters=iters)
+        return jnp.sum(preds[-1])
+
+    float(fwd(variables, batch))
+    float(fwd(variables, batch))
+    t0 = time.perf_counter()
+    outs = [fwd(variables, batch) for _ in range(steps)]
+    for o in outs:
+        float(o)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--size", type=int, nargs=2, default=(320, 720))
+    p.add_argument("--iters", type=int, default=22)
+    p.add_argument("--variants", nargs="*", default=None)
+    args = p.parse_args()
+
+    batch, (h, w), iters = args.batch, args.size, args.iters
+    data = make_batch(jax.random.PRNGKey(1), batch, h, w)
+    tcfg = TrainConfig(batch_size=batch, train_iters=iters, num_steps=200000,
+                       image_size=(h, w))
+
+    variants = {
+        "reg/full-remat": dict(corr_implementation="reg"),
+        "reg/no-remat": dict(corr_implementation="reg",
+                             remat_refinement=False),
+        "reg/save-gru": dict(corr_implementation="reg",
+                             remat_policy="save_gru_convs"),
+        "reg/save-hot": dict(corr_implementation="reg",
+                             remat_policy="save_hot"),
+        "reg/save-corr": dict(corr_implementation="reg",
+                              remat_policy="save_corr"),
+        "reg_pallas/full-remat": dict(corr_implementation="reg_pallas"),
+        "reg_pallas/save-hot": dict(corr_implementation="reg_pallas",
+                                    remat_policy="save_hot"),
+        "reg_pallas/save-corr": dict(corr_implementation="reg_pallas",
+                                     remat_policy="save_corr"),
+        "alt/full-remat": dict(corr_implementation="alt"),
+        "alt_pallas/full-remat": dict(corr_implementation="alt_pallas"),
+    }
+    if args.variants:
+        variants = {k: v for k, v in variants.items()
+                    if any(s in k for s in args.variants)}
+
+    results = {}
+    for name, overrides in variants.items():
+        cfg = RAFTStereoConfig(mixed_precision=True, **overrides)
+        model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+        tx = fetch_optimizer(tcfg)
+        state = TrainState.create(variables, tx)
+        step = jax.jit(make_train_step(model, tx, iters))
+        try:
+            dt = time_step(step, state, data)
+        except Exception as e:  # OOM etc.
+            print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+            continue
+        results[name] = dt
+        print(f"{name:28s} {dt*1e3:8.1f} ms/step  "
+              f"{batch/dt:6.2f} pairs/sec/chip", flush=True)
+
+    # iteration scaling + forward-only on the best variant
+    if not results:
+        print("all variants failed; skipping scaling runs")
+        return
+    best = min(results, key=results.get)
+    cfg = RAFTStereoConfig(mixed_precision=True, **variants[best])
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+    for n in (2, iters):
+        dt = time_fwd(model, variables, data, n)
+        print(f"fwd-only iters={n:2d} ({best})   {dt*1e3:8.1f} ms", flush=True)
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+    for n in (2,):
+        step = jax.jit(make_train_step(model, tx, n))
+        dt = time_step(step, state, data)
+        print(f"train iters={n:2d} ({best})      {dt*1e3:8.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
