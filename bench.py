@@ -29,16 +29,9 @@ from raft_stereo_tpu.training.state import TrainState, make_train_step
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 20.0
 
 
-def main():
+def run_bench(batch, h, w, train_iters, steps):
     platform = jax.devices()[0].platform
     n_chips = jax.device_count()
-    on_tpu = platform == "tpu"
-
-    # SceneFlow recipe (README.md:130); reduced shapes keep CPU smoke runs fast.
-    if on_tpu:
-        batch, (h, w), train_iters, steps = 8, (320, 720), 22, 6
-    else:
-        batch, (h, w), train_iters, steps = 2, (96, 160), 4, 3
 
     cfg = RAFTStereoConfig(mixed_precision=True)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
@@ -92,7 +85,7 @@ def main():
 
     pairs_per_sec = batch * steps / dt
     per_chip = pairs_per_sec / n_chips
-    print(json.dumps({
+    return {
         "metric": "sceneflow_train_throughput",
         "value": round(per_chip, 3),
         "unit": "pairs/sec/chip",
@@ -101,8 +94,41 @@ def main():
         "batch": batch,
         "train_iters": train_iters,
         "image_size": [h, w],
-    }))
-    return 0
+    }
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    # SceneFlow recipe (README.md:130); reduced shapes keep CPU smoke runs
+    # fast. The tunneled TPU compile service has been observed to 500 on the
+    # largest graphs when degraded — fall back to reduced recipes (flagged in
+    # the JSON) rather than report nothing.
+    if on_tpu:
+        attempts = [
+            dict(batch=8, h=320, w=720, train_iters=22, steps=6),
+            dict(batch=4, h=320, w=720, train_iters=22, steps=6),
+            dict(batch=2, h=224, w=480, train_iters=22, steps=6),
+        ]
+    else:
+        attempts = [dict(batch=2, h=96, w=160, train_iters=4, steps=3)]
+
+    last_err = None
+    for i, kw in enumerate(attempts):
+        try:
+            result = run_bench(**kw)
+        except Exception as e:  # remote-compile failure / OOM
+            last_err = e
+            print(f"bench attempt {kw} failed: {type(e).__name__}: "
+                  f"{str(e)[:160]}", file=sys.stderr)
+            continue
+        if i > 0:
+            result["note"] = ("reduced recipe fallback (primary config "
+                              "failed to compile/run)")
+        print(json.dumps(result))
+        return 0
+    print(f"all bench attempts failed: {last_err}", file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":

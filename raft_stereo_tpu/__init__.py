@@ -10,6 +10,7 @@ parallelism and Pallas kernels on the hot path.
 from raft_stereo_tpu.config import (
     RAFTStereoConfig,
     TrainConfig,
+    middlebury_finetune_config,
     realtime_config,
     rvc_config,
     sceneflow_config,
@@ -23,5 +24,6 @@ __all__ = [
     "sceneflow_config",
     "realtime_config",
     "rvc_config",
+    "middlebury_finetune_config",
     "__version__",
 ]

@@ -143,3 +143,16 @@ def realtime_config() -> RAFTStereoConfig:
 def rvc_config() -> RAFTStereoConfig:
     """README.md:81 iRaftStereo_RVC: instance-normalized context encoder."""
     return RAFTStereoConfig(context_norm="instance")
+
+
+def middlebury_finetune_config() -> tuple[RAFTStereoConfig, TrainConfig]:
+    """README.md:141 Middlebury 2014 finetune: 4k steps, lr 2e-5, batch 2,
+    crop 384x1000, warm-started from the SceneFlow checkpoint."""
+    return (
+        RAFTStereoConfig(mixed_precision=True),
+        TrainConfig(train_datasets=("middlebury_2014",), num_steps=4000,
+                    image_size=(384, 1000), lr=2e-5, batch_size=2,
+                    train_iters=22, valid_iters=32,
+                    spatial_scale=(-0.2, 0.4), saturation_range=(0.0, 1.4),
+                    restore_ckpt="models/raftstereo-sceneflow.pth"),
+    )

@@ -182,6 +182,11 @@ class RAFTStereo(nn.Module):
         coords0 = coords_grid(b, h, w)
         coords1 = coords_grid(b, h, w)
         if flow_init is not None:
+            # Stereo flow is epipolar: zero any y-component of the warm-start
+            # so flow's y-channel stays structurally zero through the loop
+            # (the deltas' y is always zeroed, raft_stereo.py:119-120; the
+            # reference's own warm starts carry y = 0 by construction).
+            flow_init = flow_init.at[..., 1].set(0.0)
             coords1 = coords1 + flow_init
 
         fused = flow_gt is not None

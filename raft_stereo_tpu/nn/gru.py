@@ -44,10 +44,20 @@ class _ConvParams(nn.Module):
 
 
 class FlowHead(nn.Module):
-    """Two 3x3 convs -> delta flow (update.py:6-14)."""
+    """Two 3x3 convs -> delta flow (update.py:6-14).
+
+    ``epipolar=True`` (the stereo model) computes only the x-channel of the
+    output conv and concatenates a zero y-channel: the model zeroes the
+    y-delta immediately anyway (raft_stereo.py:119-120), and a 2-channel conv
+    output forces a pathological (2,128)-tiled layout on TPU (measured ~3
+    TF/s). Params keep the reference's (3,3,hidden,2) shape; the y-column
+    simply receives zero gradients, exactly as if its output were computed
+    and then discarded.
+    """
 
     hidden_dim: int = 256
     output_dim: int = 2
+    epipolar: bool = False
     dtype: Optional[Dtype] = None
 
     @nn.compact
@@ -55,7 +65,14 @@ class FlowHead(nn.Module):
         x = nn.relu(checkpoint_name(
             Conv.make(self.hidden_dim, 3, 1, 1, self.dtype, "conv1")(x),
             "flow_head_hidden"))
-        return Conv.make(self.output_dim, 3, 1, 1, self.dtype, "conv2")(x)
+        if not self.epipolar or self.output_dim != 2:
+            return Conv.make(self.output_dim, 3, 1, 1, self.dtype, "conv2")(x)
+        kern, bias = _ConvParams((3, 3), x.shape[-1], 2, name="conv2")()
+        dt = self.dtype or x.dtype
+        dx = jax.lax.conv_general_dilated(
+            x.astype(dt), kern[..., :1].astype(dt), (1, 1), ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias[:1].astype(dt)
+        return jnp.concatenate([dx, jnp.zeros_like(dx)], axis=-1)
 
 
 class ConvGRU(nn.Module):
@@ -127,7 +144,17 @@ class SepConvGRU(nn.Module):
 
 
 class BasicMotionEncoder(nn.Module):
-    """Correlation + flow -> 128-d motion features (update.py:64-85)."""
+    """Correlation + flow -> 128-d motion features (update.py:64-85).
+
+    The stereo model's flow y-channel is structurally zero (flow_init's y is
+    zeroed on entry and every delta's y is zeroed, raft_stereo.py:119-120),
+    so ``convf1`` contracts only the x-channel against kernel column 0: the
+    y-column contributes zero forward value AND zero weight gradient
+    (grad = input (x) cotangent, input channel = 0), so params keep the
+    reference (7,7,2,64) shape with exact training semantics while the TPU
+    conv skips the dead half of a pathologically thin 2-input-channel
+    contraction (its weight-gradient fusion measured 2.7 TF/s).
+    """
 
     cfg: RAFTStereoConfig
     dtype: Optional[Dtype] = None
@@ -139,8 +166,13 @@ class BasicMotionEncoder(nn.Module):
             Conv.make(64, 1, 1, 0, d, "convc1")(corr), "motion_c1"))
         cor = nn.relu(checkpoint_name(
             Conv.make(64, 3, 1, 1, d, "convc2")(cor), "motion_c2"))
-        flo = nn.relu(checkpoint_name(
-            Conv.make(64, 7, 1, 3, d, "convf1")(flow), "motion_f1"))
+        kern, bias = _ConvParams((7, 7), 2, 64, name="convf1")()
+        dtc = d or flow.dtype
+        flo = jax.lax.conv_general_dilated(
+            flow[..., :1].astype(dtc), kern[..., :1, :].astype(dtc), (1, 1),
+            ((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias.astype(dtc)
+        flo = nn.relu(checkpoint_name(flo, "motion_f1"))
         flo = nn.relu(checkpoint_name(
             Conv.make(64, 3, 1, 1, d, "convf2")(flo), "motion_f2"))
         out = nn.relu(checkpoint_name(
@@ -199,7 +231,8 @@ class BasicMultiUpdateBlock(nn.Module):
         if not update:
             return tuple(net)
 
-        delta_flow = FlowHead(256, 2, dtype=d, name="flow_head")(net[0])
+        delta_flow = FlowHead(256, 2, epipolar=True, dtype=d,
+                              name="flow_head")(net[0])
 
         # scale mask to balance gradients (update.py:136-137)
         mask = checkpoint_name(

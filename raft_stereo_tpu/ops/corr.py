@@ -124,10 +124,12 @@ _LOOKUPS: Dict[str, Callable] = {}
 def register_corr(name: str, builder: Callable, lookup: Callable) -> None:
     """Register a correlation implementation (the plugin registry).
 
-    ``builder(fmap1, fmap2, num_levels, radius) -> CorrState`` and
-    ``lookup(state, coords_x) -> (B, H, W1, num_levels*(2r+1))`` features.
-    New strategies (e.g. a ring-sharded variant for very wide images) plug in
-    here without touching the model.
+    ``builder(fmap1, fmap2, num_levels, radius, *, storage_dtype=None)
+    -> CorrState`` and ``lookup(state, coords_x) -> (B, H, W1,
+    num_levels*(2r+1))`` features. ``storage_dtype`` requests
+    reduced-precision state storage (builders may ignore it, but must accept
+    the keyword). New strategies (e.g. a ring-sharded variant for very wide
+    images) plug in here without touching the model.
     """
     _BUILDERS[name] = builder
     _LOOKUPS[name] = lookup

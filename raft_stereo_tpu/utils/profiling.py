@@ -1,0 +1,152 @@
+"""TPU profiling harness: trace capture + device-op summaries.
+
+The reference's only performance instrumentation is wall-clock FPS timing in
+``validate_kitti`` (evaluate_stereo.py:77-81,105-107). The TPU-native
+equivalent is a ``jax.profiler`` trace plus an op-level breakdown of where
+device time goes — this module provides both without requiring TensorBoard:
+
+    from raft_stereo_tpu.utils.profiling import trace, summarize_trace
+
+    with trace("/tmp/myrun"):
+        for _ in range(3):
+            state, metrics = step(state, batch)
+            float(metrics["loss"])          # host fetch = real sync point
+
+    report = summarize_trace("/tmp/myrun")
+    print(format_report(report))
+
+Notes:
+
+* On tunneled TPU devices, ``jax.block_until_ready`` can return before queued
+  executions finish; fetch an output scalar per step instead (see bench.py).
+* The summary parses the Chrome-trace JSON the profiler writes alongside the
+  xplane protobuf, so it has no TensorBoard/tensorflow dependency.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Context manager: capture a ``jax.profiler`` trace into ``log_dir``."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield log_dir
+
+
+def _latest_trace_json(log_dir: str) -> Optional[str]:
+    paths = sorted(glob.glob(
+        os.path.join(log_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    return paths[-1] if paths else None
+
+
+def summarize_trace(log_dir: str, top: int = 25) -> Dict[str, Any]:
+    """Aggregate device-op time from the latest trace under ``log_dir``.
+
+    Returns ``{"trace": path, "total_device_ms": t, "by_category": [...],
+    "top_ops": [...]}`` where times are totals over the captured region
+    (divide by your step count for per-step numbers). Categories come from XLA
+    (``convolution fusion``, ``loop fusion``, ...); ``top_ops`` carries each
+    op's HLO ``long_name`` prefix so shapes are visible.
+    """
+    path = _latest_trace_json(log_dir)
+    if path is None:
+        raise FileNotFoundError(f"no trace.json.gz under {log_dir}")
+    data = json.load(gzip.open(path, "rt"))
+    events = data.get("traceEvents", [])
+
+    # device process ids ("/device:TPU:0" etc.); tid 3 = "XLA Ops" lane
+    device_pids = set()
+    op_tids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            if "/device:" in e.get("args", {}).get("name", ""):
+                device_pids.add(e["pid"])
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            if e.get("args", {}).get("name") == "XLA Ops":
+                op_tids.add((e["pid"], e["tid"]))
+
+    cat_time: collections.Counter = collections.Counter()
+    op_time: collections.Counter = collections.Counter()
+    op_count: collections.Counter = collections.Counter()
+    op_meta: Dict[str, str] = {}
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        if op_tids and (e["pid"], e.get("tid")) not in op_tids:
+            continue
+        args = e.get("args", {})
+        dur = e.get("dur", 0)
+        cat = args.get("hlo_category", "?")
+        if cat == "while":
+            continue  # wrapper op; its body ops are counted individually
+        name = e["name"]
+        cat_time[cat] += dur
+        op_time[name] += dur
+        op_count[name] += 1
+        total += dur
+        if name not in op_meta:
+            op_meta[name] = args.get("long_name", "")[:160]
+
+    return {
+        "trace": path,
+        # Host-only traces (CPU backend) carry no per-op XLA device lane;
+        # an empty summary with a note is the correct result there.
+        "note": (None if device_pids else
+                 "no XLA device lane in trace (CPU/host-only capture); "
+                 "op summaries require a TPU/GPU trace"),
+        "total_device_ms": total / 1e3,
+        "by_category": [
+            {"category": c, "ms": t / 1e3}
+            for c, t in cat_time.most_common()
+        ],
+        "top_ops": [
+            {"name": n, "ms": t / 1e3, "count": op_count[n],
+             "hlo": op_meta.get(n, "")}
+            for n, t in op_time.most_common(top)
+        ],
+    }
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    lines: List[str] = [
+        f"trace: {report['trace']}",
+        f"total device-op time: {report['total_device_ms']:.1f} ms",
+    ]
+    if report.get("note"):
+        lines.append(f"note: {report['note']}")
+    lines += ["", "by category:"]
+    for row in report["by_category"]:
+        lines.append(f"  {row['ms']:9.2f} ms  {row['category']}")
+    lines.append("")
+    lines.append("top ops:")
+    for row in report["top_ops"]:
+        lines.append(f"  {row['ms']:9.2f} ms x{row['count']:<5d} "
+                     f"{row['name'][:48]:48s} {row['hlo'][:70]}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Summarize a jax.profiler trace directory")
+    p.add_argument("log_dir")
+    p.add_argument("--top", type=int, default=25)
+    args = p.parse_args(argv)
+    print(format_report(summarize_trace(args.log_dir, args.top)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

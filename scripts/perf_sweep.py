@@ -35,7 +35,9 @@ def make_batch(rng, batch, h, w):
 
 # NOTE: on tunneled TPU devices (axon), block_until_ready has been observed
 # to return before queued executions finish (see bench.py); a host transfer
-# of an executable output is the only reliable synchronization point.
+# of an executable output is the only reliable synchronization point. The
+# warmup + lagged-fetch protocol here mirrors bench.py:run_bench — change
+# them together.
 
 def time_step(fn, state, batch, steps=4):
     state, m = fn(state, batch)  # compile + warmup

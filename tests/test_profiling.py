@@ -1,0 +1,34 @@
+"""Profiling harness: trace capture + summary (SURVEY §5 tracing row)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_stereo_tpu.utils.profiling import (format_report, summarize_trace,
+                                             trace)
+
+
+def test_trace_and_summarize(tmp_path):
+    log_dir = str(tmp_path / "trace")
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(x @ x.T)
+
+    x = jnp.ones((256, 256))
+    float(f(x))  # compile outside the trace
+    with trace(log_dir):
+        for _ in range(2):
+            float(f(x))
+
+    report = summarize_trace(log_dir)
+    assert report["total_device_ms"] >= 0
+    assert isinstance(report["by_category"], list)
+    assert isinstance(report["top_ops"], list)
+    text = format_report(report)
+    assert "total device-op time" in text
+
+
+def test_summarize_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        summarize_trace(str(tmp_path / "nope"))
