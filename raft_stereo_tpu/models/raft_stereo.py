@@ -190,6 +190,9 @@ class RAFTStereo(nn.Module):
             coords1 = coords1 + flow_init
 
         fused = flow_gt is not None
+        if fused and loss_mask is None:
+            raise ValueError("the fused-loss path needs both flow_gt and "
+                             "loss_mask (see training.loss.loss_mask)")
         if test_mode:
             mask_ch = 9 * cfg.factor ** 2
             carry = (tuple(net_list), coords1,

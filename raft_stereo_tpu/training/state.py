@@ -37,23 +37,35 @@ class TrainState(struct.PyTreeNode):
 
 
 def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
-                    axis_name=None):
+                    axis_name=None, fused_loss: bool = False):
     """Build the jittable training step.
 
     ``batch``: dict with ``image1``/``image2`` ``(B,H,W,3)`` float images,
     ``flow`` ``(B,H,W,1)``, ``valid`` ``(B,H,W)``. When ``axis_name`` is given
     (shard_map data parallelism) gradients and metrics are ``psum``-reduced
     over the mesh axis.
+
+    ``fused_loss`` switches to the in-scan reduced loss (the model sums each
+    iteration's masked L1 inside its refinement scan instead of stacking the
+    full-resolution predictions) — same math, different HBM profile; the
+    stacked default measured faster under full remat.
     """
 
     def train_step(state: TrainState, batch):
         def loss_fn(params):
-            # stacked-predictions loss: measured FASTER than the fused
-            # in-scan loss under remat (the fused variant recomputes the
-            # full-res upsample in the backward pass; +27% step time)
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            if fused_loss:
+                mask = loss_mask(batch["flow"], batch["valid"])
+                err_sums, final_flow = model.apply(
+                    variables, batch["image1"], batch["image2"],
+                    iters=train_iters, flow_gt=batch["flow"],
+                    loss_mask=mask)
+                return sequence_loss_fused(err_sums, final_flow,
+                                           batch["flow"], mask,
+                                           axis_name=axis_name)
             preds = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                batch["image1"], batch["image2"], iters=train_iters)
+                variables, batch["image1"], batch["image2"],
+                iters=train_iters)
             return sequence_loss(preds, batch["flow"], batch["valid"],
                                  axis_name=axis_name)
 

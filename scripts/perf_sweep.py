@@ -100,6 +100,7 @@ def main():
                                      remat_policy="save_corr"),
         "alt/full-remat": dict(corr_implementation="alt"),
         "alt_pallas/full-remat": dict(corr_implementation="alt_pallas"),
+        "reg/fused-loss": dict(corr_implementation="reg", _fused=True),
     }
     if args.variants:
         variants = {k: v for k, v in variants.items()
@@ -107,11 +108,13 @@ def main():
 
     results = {}
     for name, overrides in variants.items():
+        overrides = dict(overrides)
+        fused = overrides.pop("_fused", False)
         cfg = RAFTStereoConfig(mixed_precision=True, **overrides)
         model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
         tx = fetch_optimizer(tcfg)
         state = TrainState.create(variables, tx)
-        step = jax.jit(make_train_step(model, tx, iters))
+        step = jax.jit(make_train_step(model, tx, iters, fused_loss=fused))
         try:
             dt = time_step(step, state, data)
         except Exception as e:  # OOM etc.

@@ -235,3 +235,40 @@ def test_grad_accumulation_updates_every_k():
     state, _ = step(state, batch)
     leaf2 = np.asarray(state.params["fnet"]["conv2"]["kernel"])
     assert np.abs(leaf2 - leaf0).max() > 0  # k-th micro-step applied
+
+
+def test_train_step_fused_matches_stacked():
+    """make_train_step(fused_loss=True) takes one optimizer step identical
+    (within fp tolerance) to the stacked-loss default."""
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+    cfg = RAFTStereoConfig()
+    tcfg = TrainConfig(batch_size=2, train_iters=2, num_steps=100,
+                       image_size=(32, 48))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    tx = fetch_optimizer(tcfg)
+    rng = np.random.default_rng(1)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (2, 32, 48, 1)), jnp.float32),
+        "valid": jnp.ones((2, 32, 48), jnp.float32),
+    }
+
+    s0 = TrainState.create(variables, tx)
+    s_stacked, m_stacked = jax.jit(make_train_step(model, tx, 2))(s0, batch)
+    s_fused, m_fused = jax.jit(
+        make_train_step(model, tx, 2, fused_loss=True))(s0, batch)
+
+    np.testing.assert_allclose(float(m_stacked["loss"]),
+                               float(m_fused["loss"]), rtol=1e-5)
+    la = jax.tree_util.tree_leaves(s_stacked.params)
+    lb = jax.tree_util.tree_leaves(s_fused.params)
+    for a, b in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=1e-5)
