@@ -6,7 +6,6 @@ Globs left/right image pairs, runs the model in test mode, writes
 ``.npy`` disparities (reference demo.py:34-52).
 """
 
-import argparse
 import glob
 import logging
 import os
@@ -31,19 +30,7 @@ def save_colormapped(path, disparity):
 
 
 def main():
-    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU demo")
-    parser.add_argument("--restore_ckpt", required=True,
-                        help="reference .pth or orbax state dir")
-    parser.add_argument("-l", "--left_imgs", required=True,
-                        help="glob for left images")
-    parser.add_argument("-r", "--right_imgs", required=True,
-                        help="glob for right images")
-    parser.add_argument("--output_directory", default="demo_output")
-    parser.add_argument("--save_numpy", action="store_true",
-                        help="also save raw .npy disparities")
-    parser.add_argument("--valid_iters", type=int, default=32)
-    cli.add_model_args(parser)
-    args = parser.parse_args()
+    args = cli.build_demo_parser().parse_args()
 
     logging.basicConfig(level=logging.INFO)
 

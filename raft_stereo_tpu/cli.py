@@ -147,15 +147,55 @@ def load_variables(restore_ckpt: Optional[str], cfg: RAFTStereoConfig,
                    "batch_stats": restored.batch_stats}
 
 
+def build_train_parser() -> argparse.ArgumentParser:
+    """The training flag surface (reference train_stereo.py:214-249)."""
+    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU training")
+    add_train_args(parser)
+    add_model_args(parser)
+    return parser
+
+
+def build_eval_parser() -> argparse.ArgumentParser:
+    """The evaluation flag surface (reference evaluate_stereo.py:192-209)."""
+    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU evaluation")
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="reference .pth or orbax state dir")
+    parser.add_argument("--dataset", required=True,
+                        choices=["eth3d", "kitti", "things", "middlebury_F",
+                                 "middlebury_H", "middlebury_Q"])
+    parser.add_argument("--valid_iters", type=int, default=32,
+                        help="number of refinement iterations")
+    parser.add_argument("--data_root", default="datasets")
+    parser.add_argument("--bucket", type=int, default=0,
+                        help="pad eval images up to multiples of this size "
+                             "to bound recompiles (0 = exact /32 padding)")
+    add_model_args(parser)
+    return parser
+
+
+def build_demo_parser() -> argparse.ArgumentParser:
+    """The demo flag surface (reference demo.py:55-75)."""
+    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU demo")
+    parser.add_argument("--restore_ckpt", required=True,
+                        help="reference .pth or orbax state dir")
+    parser.add_argument("-l", "--left_imgs", required=True,
+                        help="glob for left images")
+    parser.add_argument("-r", "--right_imgs", required=True,
+                        help="glob for right images")
+    parser.add_argument("--output_directory", default="demo_output")
+    parser.add_argument("--save_numpy", action="store_true",
+                        help="also save raw .npy disparities")
+    parser.add_argument("--valid_iters", type=int, default=32)
+    add_model_args(parser)
+    return parser
+
+
 def _train_main():
     """Console entry point (`raft-stereo-train`); same surface as
     train_stereo.py."""
     import logging
 
-    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU training")
-    add_train_args(parser)
-    add_model_args(parser)
-    args = parser.parse_args()
+    args = build_train_parser().parse_args()
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
@@ -171,20 +211,7 @@ def _eval_main():
     from raft_stereo_tpu.eval.validate import VALIDATORS, validate_middlebury
     from raft_stereo_tpu.inference import StereoPredictor
 
-    parser = argparse.ArgumentParser(description="RAFT-Stereo TPU evaluation")
-    parser.add_argument("--restore_ckpt", default=None,
-                        help="reference .pth or orbax state dir")
-    parser.add_argument("--dataset", required=True,
-                        choices=["eth3d", "kitti", "things", "middlebury_F",
-                                 "middlebury_H", "middlebury_Q"])
-    parser.add_argument("--valid_iters", type=int, default=32,
-                        help="number of refinement iterations")
-    parser.add_argument("--data_root", default="datasets")
-    parser.add_argument("--bucket", type=int, default=0,
-                        help="pad eval images up to multiples of this size "
-                             "to bound recompiles (0 = exact /32 padding)")
-    add_model_args(parser)
-    args = parser.parse_args()
+    args = build_eval_parser().parse_args()
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")

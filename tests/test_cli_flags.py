@@ -1,0 +1,45 @@
+"""CLI flag-surface parity: every flag the reference's entry points declare
+must be accepted by the matching CLI here (the argparse surface IS the
+reference's public API — SURVEY §5 config row).
+
+The reference scripts are parsed statically (regex over ``add_argument``
+calls) so this works without importing torch-side modules.
+"""
+
+import os
+import re
+
+import pytest
+
+from raft_stereo_tpu import cli
+
+REFERENCE = "/root/reference"
+
+
+def _reference_flags(script):
+    path = os.path.join(REFERENCE, script)
+    if not os.path.isfile(path):
+        pytest.skip("reference not available")
+    text = open(path).read()
+    return set(re.findall(r"add_argument\(\s*['\"](--[\w-]+)['\"]", text))
+
+
+def _our_flags(build_parser):
+    parser = build_parser()
+    flags = set()
+    for action in parser._actions:
+        flags.update(o for o in action.option_strings if o.startswith("--"))
+    return flags
+
+
+@pytest.mark.parametrize("script,builder", [
+    ("train_stereo.py", cli.build_train_parser),
+    ("evaluate_stereo.py", cli.build_eval_parser),
+    ("demo.py", cli.build_demo_parser),
+])
+def test_reference_flags_accepted(script, builder):
+    ref = _reference_flags(script)
+    ours = _our_flags(builder)
+    missing = sorted(ref - ours)
+    assert not missing, (f"{script}: reference flags not accepted here: "
+                        f"{missing}")
