@@ -21,7 +21,12 @@ def _reference_flags(script):
     if not os.path.isfile(path):
         pytest.skip("reference not available")
     text = open(path).read()
-    return set(re.findall(r"add_argument\(\s*['\"](--[\w-]+)['\"]", text))
+    # capture every long option in each add_argument call, including flags
+    # declared short-option-first ("-l", "--left_imgs")
+    flags = set()
+    for call in re.findall(r"add_argument\(([^)]*)\)", text):
+        flags.update(re.findall(r"['\"](--[\w-]+)['\"]", call))
+    return flags
 
 
 def _our_flags(build_parser):
