@@ -15,6 +15,13 @@
 #include <cstring>
 #include <cstdlib>
 
+#if defined(__has_include)
+#if __has_include(<zlib.h>)
+#include <zlib.h>
+#define STEREODATA_HAVE_ZLIB 1
+#endif
+#endif
+
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -113,5 +120,139 @@ void collate_u8_to_f32(const uint8_t** images, int32_t n, int64_t elems,
     for (int64_t j = 0; j < elems; ++j) dst[j] = static_cast<float>(src[j]);
   }
 }
+
+// ---------------------------------------------------------------------------
+// 16-bit grayscale PNG decoder (the KITTI disparity codec: uint16 PNG,
+// disparity = value/256, 0 = invalid — reference frame_utils.py:124-127).
+// Scope: non-interlaced 16-bit greyscale (color type 0), the only form KITTI
+// ships; anything else returns an error so callers fall back to cv2.
+
+static inline uint32_t read_be32(const unsigned char* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) | (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+
+static inline int paeth(int a, int b, int c) {
+  int p = a + b - c;
+  int pa = p > a ? p - a : a - p;
+  int pb = p > b ? p - b : b - p;
+  int pc = p > c ? p - c : c - p;
+  if (pa <= pb && pa <= pc) return a;
+  return pb <= pc ? b : c;
+}
+
+#ifndef STEREODATA_HAVE_ZLIB
+// zlib headers unavailable at build time: PNG support degrades to the cv2
+// fallback (probe reports unsupported); the PFM/collate fast paths stay.
+int png16_probe(const char*, int32_t*, int32_t*) { return -100; }
+int png16_decode(const char*, int32_t, int32_t, uint16_t*) { return -100; }
+#else
+// Probe a PNG header: returns 0 and fills width/height when the file is a
+// supported (16-bit grey, non-interlaced) PNG; negative error otherwise.
+int png16_probe(const char* path, int32_t* width, int32_t* height) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[33];
+  size_t got = std::fread(hdr, 1, sizeof(hdr), f);
+  std::fclose(f);
+  if (got != sizeof(hdr)) return -2;
+  static const unsigned char sig[8] = {137, 80, 78, 71, 13, 10, 26, 10};
+  if (std::memcmp(hdr, sig, 8) != 0) return -3;
+  if (read_be32(hdr + 8) != 13 || std::memcmp(hdr + 12, "IHDR", 4) != 0)
+    return -4;
+  *width = static_cast<int32_t>(read_be32(hdr + 16));
+  *height = static_cast<int32_t>(read_be32(hdr + 20));
+  int bit_depth = hdr[24], color_type = hdr[25];
+  int compression = hdr[26], filter_method = hdr[27], interlace = hdr[28];
+  if (bit_depth != 16 || color_type != 0 || compression != 0 ||
+      filter_method != 0 || interlace != 0) return -5;
+  if (*width <= 0 || *height <= 0) return -6;
+  return 0;
+}
+
+// Decode a 16-bit greyscale PNG into `out` (H*W uint16, host byte order).
+// Returns 0 on success.
+int png16_decode(const char* path, int32_t width, int32_t height,
+                 uint16_t* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -2; }
+  void* mapped = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (mapped == MAP_FAILED) return -3;
+  const unsigned char* data = static_cast<const unsigned char*>(mapped);
+  const int64_t size = st.st_size;
+
+  // gather IDAT payloads
+  unsigned char* compressed = static_cast<unsigned char*>(std::malloc(size));
+  if (!compressed) { munmap(mapped, st.st_size); return -4; }
+  int64_t comp_len = 0;
+  int64_t off = 8;
+  int rc = -5;
+  while (off + 12 <= size) {
+    uint32_t len = read_be32(data + off);
+    const unsigned char* type = data + off + 4;
+    if (off + 12 + static_cast<int64_t>(len) > size) break;
+    if (std::memcmp(type, "IDAT", 4) == 0) {
+      std::memcpy(compressed + comp_len, data + off + 8, len);
+      comp_len += len;
+    } else if (std::memcmp(type, "IEND", 4) == 0) {
+      rc = 0;
+      break;
+    }
+    off += 12 + len;
+  }
+  munmap(mapped, st.st_size);
+  if (rc != 0 || comp_len == 0) { std::free(compressed); return -5; }
+
+  const int64_t stride = static_cast<int64_t>(width) * 2;  // bytes per row
+  const int64_t raw_len = (stride + 1) * height;           // +1 filter byte
+  unsigned char* raw = static_cast<unsigned char*>(std::malloc(raw_len));
+  if (!raw) { std::free(compressed); return -4; }
+  uLongf dest_len = static_cast<uLongf>(raw_len);
+  int zrc = uncompress(raw, &dest_len, compressed,
+                       static_cast<uLong>(comp_len));
+  std::free(compressed);
+  if (zrc != Z_OK || dest_len != static_cast<uLongf>(raw_len)) {
+    std::free(raw);
+    return -6;
+  }
+
+  // un-filter scanlines (bpp = 2 for 16-bit grey)
+  unsigned char* prev = static_cast<unsigned char*>(std::calloc(stride, 1));
+  if (!prev) { std::free(raw); return -4; }
+  for (int32_t r = 0; r < height; ++r) {
+    unsigned char* row = raw + static_cast<int64_t>(r) * (stride + 1);
+    int filter = row[0];
+    unsigned char* cur = row + 1;
+    for (int64_t i = 0; i < stride; ++i) {
+      int a = i >= 2 ? cur[i - 2] : 0;        // left (per byte-pair)
+      int b = prev[i];                        // up
+      int c = i >= 2 ? prev[i - 2] : 0;       // up-left
+      int x = cur[i];
+      switch (filter) {
+        case 0: break;
+        case 1: x += a; break;
+        case 2: x += b; break;
+        case 3: x += (a + b) / 2; break;
+        case 4: x += paeth(a, b, c); break;
+        default:
+          std::free(prev); std::free(raw); return -7;
+      }
+      cur[i] = static_cast<unsigned char>(x & 0xff);
+    }
+    // PNG stores 16-bit samples big-endian
+    uint16_t* dst = out + static_cast<int64_t>(r) * width;
+    for (int32_t i = 0; i < width; ++i) {
+      dst[i] = static_cast<uint16_t>((cur[2 * i] << 8) | cur[2 * i + 1]);
+    }
+    std::memcpy(prev, cur, stride);
+  }
+  std::free(prev);
+  std::free(raw);
+  return 0;
+}
+#endif  // STEREODATA_HAVE_ZLIB
 
 }  // extern "C"

@@ -131,6 +131,13 @@ def write_flo(path: str, flow: np.ndarray) -> None:
 # --------------------------------------------------------------------------- KITTI PNGs
 
 def _read_png_16bit(path: str) -> np.ndarray:
+    # native single-pass decoder first (zlib + unfilter in C++,
+    # native/stereodata.cpp); returns None for unsupported PNG flavors
+    from raft_stereo_tpu.data import native
+
+    img = native.read_png16(path)
+    if img is not None:
+        return img
     import cv2
 
     img = cv2.imread(path, cv2.IMREAD_ANYDEPTH | cv2.IMREAD_UNCHANGED)

@@ -69,6 +69,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.collate_u8_to_f32.argtypes = [
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)), ctypes.c_int32,
             ctypes.c_int64, ctypes.POINTER(ctypes.c_float)]
+        lib.png16_probe.restype = ctypes.c_int
+        lib.png16_probe.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.png16_decode.restype = ctypes.c_int
+        lib.png16_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint16)]
         _lib = lib
         return _lib
 
@@ -99,6 +107,29 @@ def read_pfm(path: str) -> Optional[np.ndarray]:
                         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     if rc != 0:
         raise ValueError(f"{path}: truncated/unreadable PFM (native rc={rc})")
+    return out
+
+
+def read_png16(path: str) -> Optional[np.ndarray]:
+    """Native 16-bit greyscale PNG decode (the KITTI disparity codec,
+    reference frame_utils.py:124-127) -> (H, W) uint16.
+
+    Returns None when the library is unavailable OR the file is not a
+    supported 16-bit grey non-interlaced PNG — callers fall back to cv2.
+    Raises only on files that probed as supported but fail to decode.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    if lib.png16_probe(path.encode(), ctypes.byref(w), ctypes.byref(h)) != 0:
+        return None  # unsupported flavor: defer to the cv2 path
+    out = np.empty((h.value, w.value), np.uint16)
+    rc = lib.png16_decode(path.encode(), w.value, h.value,
+                          out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)))
+    if rc != 0:
+        raise ValueError(f"{path}: corrupt 16-bit PNG (native rc={rc})")
     return out
 
 

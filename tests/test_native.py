@@ -69,3 +69,55 @@ def test_pfm_crlf_scale_line(tmp_path, have_native):
     want = frame_utils._read_pfm_numpy(p)
     np.testing.assert_array_equal(got, want)
     np.testing.assert_array_equal(got, arr)
+
+
+def test_png16_decode_matches_cv2(tmp_path):
+    """Native 16-bit PNG decoder vs cv2 on synthetic KITTI-style disparity
+    maps (varied content exercises every PNG scanline filter)."""
+    import cv2
+
+    from raft_stereo_tpu.data import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    cases = [
+        rng.integers(0, 65535, (37, 53), np.uint16),         # noise
+        np.tile(np.arange(64, dtype=np.uint16) * 512, (16, 1)),  # gradients
+        np.zeros((8, 8), np.uint16),                         # constant
+        (np.outer(np.arange(41), np.arange(29)) % 65536).astype(np.uint16),
+    ]
+    for i, arr in enumerate(cases):
+        path = str(tmp_path / f"d{i}.png")
+        assert cv2.imwrite(path, arr)
+        out = native.read_png16(path)
+        assert out is not None, "probe rejected a 16-bit grey PNG"
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_png16_probe_rejects_8bit(tmp_path):
+    """8-bit / RGB PNGs must defer to the PIL/cv2 path, not error."""
+    import cv2
+
+    from raft_stereo_tpu.data import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    path = str(tmp_path / "rgb.png")
+    assert cv2.imwrite(path, np.zeros((5, 5, 3), np.uint8))
+    assert native.read_png16(path) is None
+
+
+def test_read_disp_kitti_via_native(tmp_path):
+    """read_disp_kitti end-to-end through the native decoder."""
+    import cv2
+
+    from raft_stereo_tpu.data import frame_utils
+
+    arr = (np.arange(12, dtype=np.uint16).reshape(3, 4) * 256)
+    path = str(tmp_path / "disp.png")
+    assert cv2.imwrite(path, arr)
+    disp, valid = frame_utils.read_disp_kitti(path)
+    np.testing.assert_allclose(disp, arr.astype(np.float32) / 256.0)
+    assert valid.dtype == bool or valid.dtype == np.bool_
+    assert not valid[0, 0] and valid[1, 1]
