@@ -15,11 +15,11 @@
 #include <cstring>
 #include <cstdlib>
 
-#if defined(__has_include)
-#if __has_include(<zlib.h>)
+// STEREODATA_HAVE_ZLIB is defined by the Makefile exactly when its link
+// probe succeeds, so the compile-time and link-time decisions cannot
+// disagree (a header-only system must not leave an undefined `uncompress`).
+#ifdef STEREODATA_HAVE_ZLIB
 #include <zlib.h>
-#define STEREODATA_HAVE_ZLIB 1
-#endif
 #endif
 
 #include <fcntl.h>

@@ -48,6 +48,10 @@ class RAFTStereoConfig:
     # outputs (checkpoint_name tags in nn/gru.py) across the backward pass,
     # trading ~2 GB of HBM for skipping their recompute. None = full remat.
     remat_policy: Optional[str] = None
+    # Ours: rematerialize the encoders in the backward pass. Their
+    # full-resolution conv1/layer1 activations are multi-GB backward
+    # residuals at train shapes; recompute costs one extra encoder forward.
+    remat_encoders: bool = False
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)

@@ -42,41 +42,57 @@ def _build() -> bool:
         return False
 
 
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare every symbol's signature; AttributeError = stale library."""
+    lib.pfm_probe.restype = ctypes.c_int
+    lib.pfm_probe.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+    lib.pfm_decode.restype = ctypes.c_int
+    lib.pfm_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.collate_u8_to_f32.restype = None
+    lib.collate_u8_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)), ctypes.c_int32,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_float)]
+    lib.png16_probe.restype = ctypes.c_int
+    lib.png16_probe.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.png16_decode.restype = ctypes.c_int
+    lib.png16_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint16)]
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.isfile(_LIB_PATH) and not _build():
-            return None
+        src = os.path.join(_NATIVE_DIR, "stereodata.cpp")
+        stale = (os.path.isfile(_LIB_PATH) and os.path.isfile(src)
+                 and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
+        # Rebuild stale/missing libraries BEFORE the first dlopen: reloading
+        # the same path after a rebuild would return the cached stale handle
+        # (dlopen caches by path within a process).
+        if (not os.path.isfile(_LIB_PATH) or stale) and not _build():
+            if not os.path.isfile(_LIB_PATH):
+                return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
-            logger.info("native data-path load failed (%s)", e)
+            _bind(lib)
+        except (OSError, AttributeError) as e:
+            # AttributeError = a .so missing expected symbols (built from a
+            # different source revision with equal mtimes) — degrade to the
+            # numpy/cv2 paths rather than crash every data-layer caller.
+            logger.info("native data-path load failed (%s); "
+                        "using numpy path", e)
             return None
-        lib.pfm_probe.restype = ctypes.c_int
-        lib.pfm_probe.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
-        lib.pfm_decode.restype = ctypes.c_int
-        lib.pfm_decode.argtypes = [
-            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_float)]
-        lib.collate_u8_to_f32.restype = None
-        lib.collate_u8_to_f32.argtypes = [
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)), ctypes.c_int32,
-            ctypes.c_int64, ctypes.POINTER(ctypes.c_float)]
-        lib.png16_probe.restype = ctypes.c_int
-        lib.png16_probe.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.png16_decode.restype = ctypes.c_int
-        lib.png16_decode.argtypes = [
-            ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint16)]
         _lib = lib
         return _lib
 

@@ -141,24 +141,46 @@ class RAFTStereo(nn.Module):
         image1 = (2.0 * (image1 / 255.0) - 1.0).astype(jnp.float32)
         image2 = (2.0 * (image2 / 255.0) - 1.0).astype(jnp.float32)
 
+        # Optionally rematerialize the encoders in the backward pass: their
+        # full-resolution activations (conv1/layer1 run at image res,
+        # extractor.py:140-146) are multi-GB backward residuals at train
+        # shapes. nn.remat of a (module, x) function is transparent to
+        # parameter paths, so checkpoints are unaffected; the static kwargs
+        # (dual_inp/num_layers) are closed over.
+        def _cnet_fwd(mdl, x):
+            return mdl(x, dual_inp=cfg.shared_backbone,
+                       num_layers=cfg.n_gru_layers)
+
+        def _fnet_fwd(mdl, x):
+            return mdl(x)
+
+        if cfg.remat_encoders:
+            # prevent_cse=True (default): at the top level of a jitted
+            # function XLA CSE would otherwise merge the recomputed encoder
+            # with the primal one and keep the residuals alive (inside the
+            # refinement scan prevent_cse=False is the correct choice; here
+            # it is not).
+            _cnet_fwd = nn.remat(_cnet_fwd)
+            _fnet_fwd = nn.remat(_fnet_fwd)
+
         cnet = MultiBasicEncoder(
             output_dim=(cfg.hidden_dims, cfg.hidden_dims),
             norm_fn=cfg.context_norm, downsample=cfg.n_downsample, dtype=dt,
             name="cnet")
         if cfg.shared_backbone:
-            *cnet_list, trunk = cnet(
-                jnp.concatenate([image1, image2], axis=0), dual_inp=True,
-                num_layers=cfg.n_gru_layers)
+            *cnet_list, trunk = _cnet_fwd(
+                cnet, jnp.concatenate([image1, image2], axis=0))
             fmaps = Conv.make(256, 3, 1, 1, dt, "conv2_out")(
                 ResidualBlock(128, 128, "instance", 1, dt, name="conv2_res")(
                     trunk))
             fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
         else:
-            cnet_list = cnet(image1, num_layers=cfg.n_gru_layers)
-            fmaps = BasicEncoder(output_dim=256, norm_fn="instance",
-                                 downsample=cfg.n_downsample, dtype=dt,
-                                 name="fnet")(
-                jnp.concatenate([image1, image2], axis=0))
+            cnet_list = _cnet_fwd(cnet, image1)
+            fnet = BasicEncoder(output_dim=256, norm_fn="instance",
+                                downsample=cfg.n_downsample, dtype=dt,
+                                name="fnet")
+            fmaps = _fnet_fwd(fnet,
+                              jnp.concatenate([image1, image2], axis=0))
             fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
 
         net_list = [jnp.tanh(x[0]) for x in cnet_list]

@@ -101,6 +101,7 @@ def main():
         "alt/full-remat": dict(corr_implementation="alt"),
         "alt_pallas/full-remat": dict(corr_implementation="alt_pallas"),
         "reg/fused-loss": dict(corr_implementation="reg", _fused=True),
+        "reg/remat-enc": dict(corr_implementation="reg", remat_encoders=True),
     }
     if args.variants:
         variants = {k: v for k, v in variants.items()

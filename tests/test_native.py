@@ -121,3 +121,43 @@ def test_read_disp_kitti_via_native(tmp_path):
     np.testing.assert_allclose(disp, arr.astype(np.float32) / 256.0)
     assert valid.dtype == bool or valid.dtype == np.bool_
     assert not valid[0, 0] and valid[1, 1]
+
+
+def test_stale_library_rebuilds(tmp_path):
+    """A stale .so missing newly-added symbols is rebuilt before first load
+    (fresh process: the real-world 'old checkout pulled new code' case)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    from raft_stereo_tpu.data import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    src = tmp_path / "empty.cpp"
+    src.write_text('extern "C" int unrelated_symbol() { return 0; }\n')
+    decoy = tmp_path / "decoy.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(decoy), str(src)],
+                   check=True)
+    backup = native._LIB_PATH + ".bak"
+    shutil.copy(native._LIB_PATH, backup)
+    try:
+        shutil.copy(str(decoy), native._LIB_PATH)
+        cpp = os.path.join(os.path.dirname(native._LIB_PATH),
+                           "stereodata.cpp")
+        os.utime(native._LIB_PATH, (0, os.path.getmtime(cpp) - 10))
+        # fresh interpreter: no dlopen handle cached for the path
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, '/root/repo'); "
+             "from raft_stereo_tpu.data import native; "
+             "print(native.available())"],
+            capture_output=True, text=True, timeout=180)
+        assert probe.stdout.strip().endswith("True"), probe.stderr[-500:]
+    finally:
+        shutil.move(backup, native._LIB_PATH)
+        # NOTE: no available() assert here — this process's dlopen cache is
+        # poisoned by the decoy-content inode; fresh processes are fine.
+        native._lib = None
+        native._tried = False
