@@ -33,7 +33,8 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False):
     platform = jax.devices()[0].platform
     n_chips = jax.device_count()
 
-    cfg = RAFTStereoConfig(mixed_precision=True)
+    cfg = RAFTStereoConfig(mixed_precision=True,
+                           corr_storage_dtype="bfloat16")
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 

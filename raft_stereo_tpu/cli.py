@@ -41,6 +41,11 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--no_remat", action="store_true",
                    help="disable refinement-loop rematerialization "
                         "(faster, much more HBM)")
+    g.add_argument("--corr_storage_dtype",
+                   choices=["float32", "bfloat16"], default=None,
+                   help="correlation-volume storage precision; default "
+                        "matches the reference (fp32 for reg/alt, compute "
+                        "dtype for the *_pallas kernels)")
 
 
 def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
@@ -56,6 +61,7 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         n_gru_layers=args.n_gru_layers,
         mixed_precision=args.mixed_precision,
         remat_refinement=not getattr(args, "no_remat", False),
+        corr_storage_dtype=getattr(args, "corr_storage_dtype", None),
     )
 
 

@@ -44,10 +44,12 @@ class RAFTStereoConfig:
     # (jax.checkpoint). Without it the scan stores every iteration's conv
     # activations and SceneFlow-shape training OOMs on a 16 GB chip.
     remat_refinement: bool = True
-    # Selective-remat policy: "save_gru_convs" keeps the named GRU gate conv
-    # outputs (checkpoint_name tags in nn/gru.py) across the backward pass,
-    # trading ~2 GB of HBM for skipping their recompute. None = full remat.
-    remat_policy: Optional[str] = None
+    # Ours: correlation-volume storage precision. None = match the reference
+    # (core/raft_stereo.py:92-95): fp32 for "reg"/"alt"; the compute dtype for
+    # the Pallas implementations (the reference's CUDA kernels are the fp16
+    # precedent, sampler_kernel.cu:126). "bfloat16" halves lookup bandwidth
+    # (accumulation stays fp32 in the builders) — opt-in for training recipes.
+    corr_storage_dtype: Optional[str] = None
     # Ours: rematerialize the encoders in the backward pass. Their
     # full-resolution conv1/layer1 activations are multi-GB backward
     # residuals at train shapes; recompute costs one extra encoder forward.
@@ -63,11 +65,10 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
-        if self.remat_policy not in (None, "save_gru_convs", "save_hot",
-                                     "save_corr"):
-            raise ValueError(f"unknown remat_policy {self.remat_policy!r}; "
-                             "expected None, 'save_gru_convs', 'save_hot' "
-                             "or 'save_corr'")
+        if self.corr_storage_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                f"unknown corr_storage_dtype {self.corr_storage_dtype!r}; "
+                "expected None, 'float32' or 'bfloat16'")
         if len(self.hidden_dims) != 3 or self.hidden_dims[0] != self.hidden_dims[2]:
             # The reference wires context conv i (sized hidden_dims[i]) into the
             # GRU at level i whose hidden size is hidden_dims[2-i]
@@ -130,7 +131,9 @@ class TrainConfig:
 def sceneflow_config() -> tuple[RAFTStereoConfig, TrainConfig]:
     """README.md:130 SceneFlow recipe: batch 8, 22 train iters, 200k steps, bf16."""
     return (
-        RAFTStereoConfig(mixed_precision=True),
+        # bf16 volume storage is an explicit training opt-in (measured win,
+        # PERF.md); eval-time parity checks run the fp32 default.
+        RAFTStereoConfig(mixed_precision=True, corr_storage_dtype="bfloat16"),
         TrainConfig(batch_size=8, train_iters=22, num_steps=200000,
                     spatial_scale=(-0.2, 0.4), saturation_range=(0.0, 1.4)),
     )

@@ -130,9 +130,11 @@ def read_png16(path: str) -> Optional[np.ndarray]:
     """Native 16-bit greyscale PNG decode (the KITTI disparity codec,
     reference frame_utils.py:124-127) -> (H, W) uint16.
 
-    Returns None when the library is unavailable OR the file is not a
-    supported 16-bit grey non-interlaced PNG — callers fall back to cv2.
-    Raises only on files that probed as supported but fail to decode.
+    Returns None when the library is unavailable, the file is not a
+    supported 16-bit grey non-interlaced PNG, OR the decode itself fails
+    (truncated IDAT, CRC-corrupt or nonstandard zlib stream) — callers fall
+    back to cv2, which tolerates more minor nonconformance than this
+    strict decoder; if the file is truly corrupt cv2 raises there.
     """
     lib = _load()
     if lib is None:
@@ -145,7 +147,9 @@ def read_png16(path: str) -> Optional[np.ndarray]:
     rc = lib.png16_decode(path.encode(), w.value, h.value,
                           out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)))
     if rc != 0:
-        raise ValueError(f"{path}: corrupt 16-bit PNG (native rc={rc})")
+        logger.warning("%s: native 16-bit PNG decode failed (rc=%d); "
+                       "falling back to cv2", path, rc)
+        return None
     return out
 
 

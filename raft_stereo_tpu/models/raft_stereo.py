@@ -64,11 +64,6 @@ class RefinementStep(nn.Module):
         corr = corr_lookup(corr_state, coords1)
         flow = coords1 - coords0
 
-        # Tag the (compute-dtype) lookup output for selective-remat policies:
-        # the pyramid lookup is by far the costliest recompute per byte saved
-        # (a full pass over the volume pyramid vs a (B, H, W,
-        # num_levels*(2r+1)) slab). Tagged unconditionally — checkpoint_name
-        # is identity when no policy saves it.
         dt0 = self.dtype
         corr = checkpoint_name(corr.astype(dt0) if dt0 else corr, "corr_feats")
 
@@ -195,10 +190,19 @@ class RAFTStereo(nn.Module):
             for i, inp in enumerate(inp_list)
         ]
 
+        # Volume storage precision (config.corr_storage_dtype): default
+        # mirrors the reference — fp32 for reg/alt (raft_stereo.py:92-95),
+        # compute dtype for the Pallas kernels (fp16 CUDA precedent).
+        if cfg.corr_storage_dtype is not None:
+            storage_dt = jnp.dtype(cfg.corr_storage_dtype)
+        elif cfg.corr_implementation.endswith("_pallas"):
+            storage_dt = dt
+        else:
+            storage_dt = None
         corr_state = init_corr(cfg.corr_implementation, fmap1, fmap2,
                                num_levels=cfg.corr_levels,
                                radius=cfg.corr_radius,
-                               storage_dtype=dt)
+                               storage_dtype=storage_dt)
 
         b, h, w, _ = net_list[0].shape
         coords0 = coords_grid(b, h, w)
@@ -231,28 +235,12 @@ class RAFTStereo(nn.Module):
         # (~0.6 GB per conv buffer at the SceneFlow train shape, 22 iters) and
         # training OOMs on a 16 GB chip. Remat recomputes them from the carry
         # instead — the jax.checkpoint FLOPs-for-HBM trade.
+        # Full remat (no selective save policy): every selective policy tried
+        # (saving the GRU gate convs, the corr lookup, or both) measured
+        # SLOWER than recompute — writing 22x residual slabs costs more HBM
+        # traffic than the extra FLOPs (PERF.md experiment log).
         if cfg.remat_refinement:
-            remat_kwargs = {"prevent_cse": False}
-            if cfg.remat_policy == "save_gru_convs":
-                remat_kwargs["policy"] = \
-                    jax.checkpoint_policies.save_only_these_names(
-                        "gru_zr", "gru_q")
-            elif cfg.remat_policy == "save_hot":
-                # Knapsack-chosen save set (~91 MB/iter bf16): the corr
-                # lookup output (costliest recompute per byte — a full
-                # volume-pyramid pass) plus the fused GRU gate convs.
-                # Broader sets (adding the motion-encoder convs) overflow
-                # a 16 GB chip at the SceneFlow train shape and fail
-                # compilation; flow_head/mask hidden convs recompute at
-                # near-peak MXU rates and stay remat'd.
-                remat_kwargs["policy"] = \
-                    jax.checkpoint_policies.save_only_these_names(
-                        "corr_feats", "gru_zr", "gru_q")
-            elif cfg.remat_policy == "save_corr":
-                remat_kwargs["policy"] = \
-                    jax.checkpoint_policies.save_only_these_names(
-                        "corr_feats")
-            body = nn.remat(RefinementStep, **remat_kwargs)
+            body = nn.remat(RefinementStep, prevent_cse=False)
         else:
             body = RefinementStep
         step = nn.scan(

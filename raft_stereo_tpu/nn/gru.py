@@ -104,7 +104,9 @@ class ConvGRU(nn.Module):
         zr = jax.lax.conv_general_dilated(
             hx.astype(dt), kernel, (1, 1), ((p, p), (p, p)),
             dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
-        # names for selective rematerialization policies (no-op otherwise)
+        # checkpoint_name tags here and below are identity markers kept for
+        # remat experiments; no shipped policy consumes them (every selective
+        # save policy measured slower than full remat, PERF.md).
         zr = checkpoint_name(zr, "gru_zr")
         z, r = jnp.split(zr, 2, axis=-1)
         z = nn.sigmoid(z + cz)

@@ -1,9 +1,10 @@
 """Perf sweep on real TPU: time train-step variants to find throughput headroom.
 
 Times the SceneFlow-recipe training step (batch 8, 22 iters, 320x720) across
-corr implementations and remat policies, plus forward-only and iteration-count
-scaling to split per-iteration cost from fixed cost. Prints one line per
-variant: pairs/sec/chip and ms/step.
+corr implementations, volume-storage precisions, remat on/off and the
+fused-loss path, plus forward-only and iteration-count scaling to split
+per-iteration cost from fixed cost. Prints one line per variant:
+pairs/sec/chip and ms/step.
 """
 
 import argparse
@@ -87,17 +88,9 @@ def main():
         "reg/full-remat": dict(corr_implementation="reg"),
         "reg/no-remat": dict(corr_implementation="reg",
                              remat_refinement=False),
-        "reg/save-gru": dict(corr_implementation="reg",
-                             remat_policy="save_gru_convs"),
-        "reg/save-hot": dict(corr_implementation="reg",
-                             remat_policy="save_hot"),
-        "reg/save-corr": dict(corr_implementation="reg",
-                              remat_policy="save_corr"),
+        "reg/fp32-volume": dict(corr_implementation="reg",
+                                corr_storage_dtype="float32"),
         "reg_pallas/full-remat": dict(corr_implementation="reg_pallas"),
-        "reg_pallas/save-hot": dict(corr_implementation="reg_pallas",
-                                    remat_policy="save_hot"),
-        "reg_pallas/save-corr": dict(corr_implementation="reg_pallas",
-                                     remat_policy="save_corr"),
         "alt/full-remat": dict(corr_implementation="alt"),
         "alt_pallas/full-remat": dict(corr_implementation="alt_pallas"),
         "reg/fused-loss": dict(corr_implementation="reg", _fused=True),
@@ -111,6 +104,7 @@ def main():
     for name, overrides in variants.items():
         overrides = dict(overrides)
         fused = overrides.pop("_fused", False)
+        overrides.setdefault("corr_storage_dtype", "bfloat16")
         cfg = RAFTStereoConfig(mixed_precision=True, **overrides)
         model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
         tx = fetch_optimizer(tcfg)
@@ -130,15 +124,19 @@ def main():
         print("all variants failed; skipping scaling runs")
         return
     best = min(results, key=results.get)
-    cfg = RAFTStereoConfig(mixed_precision=True, **variants[best])
+    best_overrides = dict(variants[best])
+    best_overrides.pop("_fused", None)
+    best_overrides.setdefault("corr_storage_dtype", "bfloat16")
+    cfg = RAFTStereoConfig(mixed_precision=True, **best_overrides)
     model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
     for n in (2, iters):
         dt = time_fwd(model, variables, data, n)
         print(f"fwd-only iters={n:2d} ({best})   {dt*1e3:8.1f} ms", flush=True)
     tx = fetch_optimizer(tcfg)
     state = TrainState.create(variables, tx)
+    best_fused = variants[best].get("_fused", False)
     for n in (2,):
-        step = jax.jit(make_train_step(model, tx, n))
+        step = jax.jit(make_train_step(model, tx, n, fused_loss=best_fused))
         dt = time_step(step, state, data)
         print(f"train iters={n:2d} ({best})      {dt*1e3:8.1f} ms", flush=True)
 

@@ -21,11 +21,19 @@ def _reference_flags(script):
     if not os.path.isfile(path):
         pytest.skip("reference not available")
     text = open(path).read()
-    # capture every long option in each add_argument call, including flags
-    # declared short-option-first ("-l", "--left_imgs")
+    # Capture every long option in each add_argument call, including flags
+    # declared short-option-first ("-l", "--left_imgs"). Option strings are
+    # the *leading* quoted arguments of the call, so match the run of quoted
+    # tokens right after "add_argument(" — robust to parentheses later in the
+    # same call (a paren inside default=/choices= would truncate a naive
+    # "[^)]*" span and silently drop flags declared after it).
     flags = set()
-    for call in re.findall(r"add_argument\(([^)]*)\)", text):
-        flags.update(re.findall(r"['\"](--[\w-]+)['\"]", call))
+    for m in re.finditer(r"add_argument\(", text):
+        lead = re.match(r"(?:\s*['\"]-{1,2}[\w-]+['\"]\s*,)*"
+                        r"\s*['\"]-{1,2}[\w-]+['\"]",
+                        text[m.end():])
+        if lead:
+            flags.update(re.findall(r"['\"](--[\w-]+)['\"]", lead.group(0)))
     return flags
 
 
