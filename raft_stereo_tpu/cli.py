@@ -21,9 +21,10 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    help="hidden state and context dimensions")
     g.add_argument("--corr_implementation",
                    choices=["reg", "alt", "reg_cuda", "alt_cuda",
-                            "reg_pallas", "alt_pallas"], default="reg",
+                            "reg_pallas", "alt_pallas", "ring"], default="reg",
                    help="correlation volume implementation "
-                        "(*_cuda aliases map to the *_pallas TPU kernels)")
+                        "(*_cuda aliases map to the *_pallas TPU kernels; "
+                        "ring = width-sharded sequence parallelism)")
     g.add_argument("--shared_backbone", action="store_true",
                    help="use a single backbone for context and feature nets")
     g.add_argument("--corr_levels", type=int, default=4)

@@ -15,8 +15,10 @@ from typing import Optional, Sequence, Tuple
 
 # The plugin switch preserved from the reference (--corr_implementation,
 # core/raft_stereo.py:90-100). "reg_pallas"/"alt_pallas" replace the CUDA
-# extensions ("reg_cuda"/"alt_cuda") with TPU Pallas kernels.
-CORR_IMPLEMENTATIONS = ("reg", "alt", "reg_pallas", "alt_pallas")
+# extensions ("reg_cuda"/"alt_cuda") with TPU Pallas kernels; "ring" is the
+# sequence-parallel variant for very wide images (W sharded over the mesh's
+# 'seq' axis, fmap2 blocks ppermuted ring-style — SURVEY §5 long-context row).
+CORR_IMPLEMENTATIONS = ("reg", "alt", "reg_pallas", "alt_pallas", "ring")
 # Aliases so reference command lines keep working.
 CORR_ALIASES = {"reg_cuda": "reg_pallas", "alt_cuda": "alt_pallas"}
 
@@ -50,6 +52,15 @@ class RAFTStereoConfig:
     # precedent, sampler_kernel.cu:126). "bfloat16" halves lookup bandwidth
     # (accumulation stays fp32 in the builders) — opt-in for training recipes.
     corr_storage_dtype: Optional[str] = None
+    # Ours: in training, emit (lowres flow, mask) from the refinement scan
+    # and run ONE batched convex upsample over all iterations after it,
+    # instead of 22 small per-iteration upsamples inside the scan body —
+    # fewer latency-bound ops, and the upsample is never rematerialized in
+    # the backward pass (its inputs are saved scan outputs).
+    deferred_upsample: bool = False
+    # Ours: lax.scan unroll factor for the refinement loop (XLA can fuse
+    # and overlap across iteration boundaries; costs compile time).
+    scan_unroll: int = 1
     # Ours: rematerialize the encoders in the backward pass. Their
     # full-resolution conv1/layer1 activations are multi-GB backward
     # residuals at train shapes; recompute costs one extra encoder forward.

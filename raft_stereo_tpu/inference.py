@@ -51,6 +51,17 @@ class StereoPredictor:
         self.valid_iters = valid_iters
         self.bucket = bucket
         self._compiled: Dict[Tuple[int, int, int, int], any] = {}
+        # "ring" shards the width axis over every available device (sequence
+        # parallelism for very wide pairs). Pad W so each device's 1/factor-
+        # resolution shard still pools 2^(levels-1)-fold locally.
+        self._mesh = None
+        self._w_divis = PAD_DIVIS
+        if cfg.corr_implementation == "ring" and len(jax.devices()) > 1:
+            from raft_stereo_tpu.parallel.mesh import make_mesh
+            n = len(jax.devices())
+            self._mesh = make_mesh(1, n)
+            self._w_divis = max(
+                PAD_DIVIS, cfg.factor * n * 2 ** (cfg.corr_levels - 1))
 
     def _forward(self, shape: Tuple[int, int, int], iters: int):
         key = shape + (iters,)
@@ -77,11 +88,19 @@ class StereoPredictor:
         padder = InputPadder(
             image1.shape, divis_by=PAD_DIVIS,
             target=(bucket_size(h, PAD_DIVIS, self.bucket),
-                    bucket_size(w, PAD_DIVIS, self.bucket))
-            if self.bucket else None)
+                    bucket_size(w, self._w_divis, self.bucket)))
         im1, im2 = padder.pad(image1, image2)
-        fn = self._forward(tuple(im1.shape[:3]), iters)
-        _, flow_up = fn(self.variables, im1, im2)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
+            spec = NamedSharding(self._mesh, P(None, None, SEQ_AXIS, None))
+            im1, im2 = jax.device_put(im1, spec), jax.device_put(im2, spec)
+            with self._mesh:
+                fn = self._forward(tuple(im1.shape[:3]), iters)
+                _, flow_up = fn(self.variables, im1, im2)
+        else:
+            fn = self._forward(tuple(im1.shape[:3]), iters)
+            _, flow_up = fn(self.variables, im1, im2)
         return np.asarray(padder.unpad(flow_up))
 
     def compute_disparity(self, left: np.ndarray, right: np.ndarray,

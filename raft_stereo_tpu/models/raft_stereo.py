@@ -53,6 +53,7 @@ class RefinementStep(nn.Module):
     cfg: RAFTStereoConfig
     test_mode: bool = False
     fused: bool = False
+    deferred: bool = False
     dtype: Optional[Dtype] = None
 
     @nn.compact
@@ -88,6 +89,10 @@ class RefinementStep(nn.Module):
         if self.test_mode:
             # intermediate upsampling skipped (raft_stereo.py:126-127)
             return (net, coords1, mask.astype(jnp.float32)), None
+        if self.deferred:
+            # deferred-upsample: emit the low-res flow and (compute-dtype)
+            # mask; one batched upsample runs after the scan.
+            return (net, coords1), ((coords1 - coords0)[..., :1], mask)
         flow_up = upsample_disparity_convex(coords1 - coords0,
                                             mask.astype(jnp.float32),
                                             cfg.factor)
@@ -243,6 +248,7 @@ class RAFTStereo(nn.Module):
             body = nn.remat(RefinementStep, prevent_cse=False)
         else:
             body = RefinementStep
+        deferred = (cfg.deferred_upsample and not test_mode and not fused)
         step = nn.scan(
             body,
             variable_broadcast="params",
@@ -250,7 +256,8 @@ class RAFTStereo(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
-        )(cfg, test_mode, fused, dt, name="refinement")
+            unroll=cfg.scan_unroll,
+        )(cfg, test_mode, fused, deferred, dt, name="refinement")
         gt_and_mask = None
         if fused:
             gt_and_mask = (flow_gt.astype(jnp.float32),
@@ -265,6 +272,14 @@ class RAFTStereo(nn.Module):
             return coords1 - coords0, flow_up
         if fused:
             return flow_predictions, carry[2]
+        if deferred:
+            lowres, masks = flow_predictions  # (it,B,h,w,1), (it,B,h,w,9f^2)
+            it, bb, hp, wp = lowres.shape[:4]
+            up = upsample_disparity_convex(
+                lowres.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
+                masks.reshape(it * bb, hp, wp, -1).astype(jnp.float32),
+                cfg.factor)
+            return up.reshape(it, bb, hp * cfg.factor, wp * cfg.factor, 1)
         return flow_predictions
 
 

@@ -36,10 +36,11 @@ from raft_stereo_tpu.ops.sampler import windowed_linear_sample
 class CorrState:
     """Pytree correlation state threaded through the refinement scan."""
 
-    levels: Tuple[jax.Array, ...]  # per-level volume (reg) or fmap2 (alt)
+    levels: Tuple[jax.Array, ...]  # per-level volume (reg) or fmap2 (alt/ring)
     fmap1: jax.Array | None        # left features, only for alt-style lookups
     impl: str = struct.field(pytree_node=False)
     radius: int = struct.field(pytree_node=False)
+    num_levels: int = struct.field(pytree_node=False, default=4)
 
 
 def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
@@ -68,7 +69,8 @@ def _build_reg(fmap1, fmap2, num_levels, radius,
     levels = [volume]
     for _ in range(num_levels - 1):
         levels.append(pool_last_axis2(levels[-1]))
-    return CorrState(levels=tuple(levels), fmap1=None, impl="reg", radius=radius)
+    return CorrState(levels=tuple(levels), fmap1=None, impl="reg",
+                     radius=radius, num_levels=num_levels)
 
 
 def _build_alt(fmap1, fmap2, num_levels, radius,
@@ -79,7 +81,8 @@ def _build_alt(fmap1, fmap2, num_levels, radius,
     levels = [fmap2]
     for _ in range(num_levels - 1):
         levels.append(pool_w2(levels[-1]))
-    return CorrState(levels=tuple(levels), fmap1=fmap1, impl="alt", radius=radius)
+    return CorrState(levels=tuple(levels), fmap1=fmap1, impl="alt",
+                     radius=radius, num_levels=num_levels)
 
 
 def _lookup_reg(state: CorrState, coords_x: jax.Array) -> jax.Array:
@@ -117,6 +120,88 @@ def _lookup_alt(state: CorrState, coords_x: jax.Array) -> jax.Array:
     return jnp.concatenate(out, axis=-1)
 
 
+def _build_ring(fmap1, fmap2, num_levels, radius,
+                storage_dtype=None) -> CorrState:
+    """Ring-sharded alt: keep raw feature maps; pooling happens per ring
+    block inside the lookup (parallel/ring_corr.py).
+
+    With no ``seq``-sharded mesh in scope at trace time, degrade to a plain
+    alt state HERE (pyramid pooled once at init) rather than per-lookup, so
+    the fallback costs exactly what alt costs."""
+    from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
+
+    mesh = _ambient_mesh()
+    if (mesh is None or SEQ_AXIS not in mesh.axis_names
+            or mesh.shape[SEQ_AXIS] == 1):
+        import warnings
+        warnings.warn(
+            "corr_implementation 'ring' has no mesh with a sharded 'seq' "
+            "axis in scope; falling back to the unsharded 'alt' lookup "
+            "(same semantics, no width sharding). Trace under "
+            "`with make_mesh(data, seq):` to enable the ring.")
+        return _build_alt(fmap1, fmap2, num_levels, radius,
+                          storage_dtype=storage_dtype)
+    dt = storage_dtype or jnp.float32
+    return CorrState(levels=(fmap2.astype(dt),), fmap1=fmap1.astype(dt),
+                     impl="ring", radius=radius, num_levels=num_levels)
+
+
+def _ambient_mesh():
+    """The device mesh in scope at trace time, if any.
+
+    Prefers the modern abstract mesh (``jax.sharding.use_mesh``); falls back
+    to the legacy global physical mesh set by ``with mesh:`` (what the pjit
+    step builders in parallel/ use).
+    """
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        m = get_abstract()
+        if m is not None and getattr(m, "axis_names", ()):
+            return m
+    try:
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            from jax.interpreters.pxla import thread_resources
+        m = thread_resources.env.physical_mesh
+        if m.axis_names:
+            return m
+    except Exception:
+        pass
+    return None
+
+
+def _lookup_ring(state: CorrState, coords_x: jax.Array) -> jax.Array:
+    """Sequence-parallel pyramid lookup: ppermute fmap2 blocks around the
+    mesh's ``seq`` axis, summing exact per-block contributions (SURVEY §5
+    long-context row — ring-attention-shaped, but for correlation).
+
+    Outside any mesh (or with an unsharded ``seq`` axis) this degrades to the
+    unsharded alt lookup — identical semantics, no collectives — so the
+    "ring" plugin is runnable everywhere. (Normally :func:`_build_ring`
+    already catches the no-mesh case at init; this branch only triggers if
+    the mesh disappeared between init and lookup within one trace.)
+    """
+    from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
+
+    mesh = _ambient_mesh()
+    if (mesh is None or SEQ_AXIS not in mesh.axis_names
+            or mesh.shape[SEQ_AXIS] == 1):
+        fmap2 = state.levels[0]
+        levels = [fmap2]
+        for _ in range(state.num_levels - 1):
+            levels.append(pool_w2(levels[-1]))
+        alt_state = CorrState(levels=tuple(levels), fmap1=state.fmap1,
+                              impl="alt", radius=state.radius,
+                              num_levels=state.num_levels)
+        return _lookup_alt(alt_state, coords_x)
+
+    from raft_stereo_tpu.parallel.ring_corr import make_ring_lookup
+    ring = make_ring_lookup(mesh, radius=state.radius,
+                            num_levels=state.num_levels)
+    return ring(state.fmap1, state.levels[0], coords_x)
+
+
 _BUILDERS: Dict[str, Callable] = {}
 _LOOKUPS: Dict[str, Callable] = {}
 
@@ -137,6 +222,7 @@ def register_corr(name: str, builder: Callable, lookup: Callable) -> None:
 
 register_corr("reg", _build_reg, _lookup_reg)
 register_corr("alt", _build_alt, _lookup_alt)
+register_corr("ring", _build_ring, _lookup_ring)
 
 
 def init_corr(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,

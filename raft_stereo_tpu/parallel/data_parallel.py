@@ -28,7 +28,7 @@ from typing import Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from raft_stereo_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -51,7 +51,7 @@ def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh):
         per_shard_step, mesh=mesh,
         in_specs=(P(), batch_spec),
         out_specs=(P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 

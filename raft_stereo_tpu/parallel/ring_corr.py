@@ -35,7 +35,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from raft_stereo_tpu.ops.geometry import pool_w2
 from raft_stereo_tpu.ops.sampler import windowed_linear_sample
@@ -99,10 +99,15 @@ def make_ring_lookup(mesh: Mesh, *, radius: int = 4, num_levels: int = 4):
     """Wrap :func:`ring_corr_lookup` in shard_map over the mesh's seq axis.
 
     Returns a function of GLOBAL arrays ``(fmap1, fmap2, coords) -> corr``
-    whose intermediates are fully W-sharded.
+    whose intermediates are fully W-sharded. The batch axis is sharded over
+    the mesh's ``data`` axis (if present) so the ring composes with data
+    parallelism: each data-shard runs its own seq-axis ring.
     """
-    spec_f = P(None, None, SEQ_AXIS, None)
-    spec_c = P(None, None, SEQ_AXIS)
+    from raft_stereo_tpu.parallel.mesh import DATA_AXIS
+
+    data = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+    spec_f = P(data, None, SEQ_AXIS, None)
+    spec_c = P(data, None, SEQ_AXIS)
 
     def lookup(fmap1, fmap2, coords):
         return ring_corr_lookup(fmap1, fmap2, coords, radius=radius,
@@ -111,4 +116,4 @@ def make_ring_lookup(mesh: Mesh, *, radius: int = 4, num_levels: int = 4):
     return shard_map(lookup, mesh=mesh,
                      in_specs=(spec_f, spec_f, spec_c),
                      out_specs=spec_c,
-                     check_rep=False)
+                     check_vma=False)

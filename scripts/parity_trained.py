@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Trained-scale EPE parity vs the PyTorch reference (no datasets needed).
+
+``parity_check.py`` compares random-init models on random images; this script
+closes the remaining acceptance gap ("EPE within 1% of the PyTorch baseline",
+BASELINE.md) at *trained* scale without network access:
+
+1. Builds synthetic stereo pairs with KNOWN ground truth: a smooth random
+   disparity field warps a smooth random texture (img2(x) = img1(x - d(x))),
+   so EPE against GT is well-defined for both models.
+2. Trains the torch reference for a few hundred steps on such pairs — with
+   BatchNorm running stats UPDATING (unlike the reference's freeze_bn
+   training) so the converted checkpoint carries non-trivial BN statistics,
+   where conversion bugs and bf16 drift actually bite.
+3. Converts the trained state dict (utils/checkpoint_convert.py) and
+   evaluates BOTH models at full SceneFlow eval scale (320x720 pad /32,
+   32 iters, fp32): the acceptance criterion is relative EPE deviation
+   |EPE_jax - EPE_torch| / EPE_torch <= --tolerance (default 1%).
+4. Also reports (does not gate on) the mixed-precision bf16 deltas: compute
+   dtype bf16, and bf16 correlation-volume storage (config
+   corr_storage_dtype) — the measured numbers PERF/PARITY cite.
+
+Run: python scripts/parity_trained.py [--train_steps 150] [--pairs 3]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def smooth_field(rng, h, w, channels, octaves=4, base=8):
+    """Sum of bilinearly-upsampled random grids — a cheap smooth texture."""
+    out = np.zeros((h, w, channels), np.float32)
+    try:
+        import cv2
+        resize = lambda g: cv2.resize(g, (w, h), interpolation=cv2.INTER_LINEAR)
+    except ImportError:
+        from PIL import Image
+        def resize(g):
+            return np.stack(
+                [np.asarray(Image.fromarray(g[..., c]).resize(
+                    (w, h), Image.BILINEAR)) for c in range(g.shape[-1])],
+                axis=-1)
+    for o in range(octaves):
+        gh, gw = base * (2 ** o), base * (2 ** o)
+        grid = rng.standard_normal((gh, gw, channels)).astype(np.float32)
+        r = resize(grid)
+        if r.ndim == 2:
+            r = r[..., None]
+        out += r / (2 ** o)
+    return out
+
+
+def make_pair(rng, h, w, max_disp=48.0):
+    """(img1, img2, disparity) with img2 the GT-warped img1."""
+    tex = smooth_field(rng, h, w, 3)
+    tex = (tex - tex.min()) / (tex.ptp() + 1e-6) * 255.0
+    d = smooth_field(rng, h, w, 1, octaves=3)
+    d = (d - d.min()) / (d.ptp() + 1e-6) * rng.uniform(0.3, 1.0) * max_disp
+    # img2(x) = img1(x - d): sample img1 at x + d? No — disparity convention:
+    # left pixel x matches right pixel x - d. We synthesize the RIGHT image
+    # by sampling the left texture at x + d_right ~ x + d (approximate
+    # inverse warp with the same smooth field; GT stays exact for the left
+    # image by re-deriving d from the constructed correspondence).
+    xs = np.arange(w, dtype=np.float32)[None, :, None] + d
+    x0 = np.clip(np.floor(xs), 0, w - 1).astype(np.int64)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    frac = np.clip(xs - x0, 0.0, 1.0)
+    rows = np.arange(h)[:, None, None]
+    img2 = (tex[rows, x0[..., 0], :] * (1 - frac) +
+            tex[rows, x1[..., 0], :] * frac)
+    return tex.astype(np.float32), img2.astype(np.float32), d[..., 0]
+
+
+def epe(disp_pred, disp_gt):
+    return float(np.mean(np.abs(disp_pred - disp_gt)))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--reference_dir", default="/root/reference")
+    p.add_argument("--train_steps", type=int, default=150)
+    p.add_argument("--train_size", type=int, nargs=2, default=[128, 256])
+    p.add_argument("--train_iters", type=int, default=7)
+    p.add_argument("--eval_size", type=int, nargs=2, default=[320, 720])
+    p.add_argument("--eval_iters", type=int, default=32)
+    p.add_argument("--pairs", type=int, default=3)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--tolerance", type=float, default=0.01,
+                   help="max relative EPE deviation vs torch (1%% default)")
+    args = p.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import torch
+
+    sys.path.insert(0, args.reference_dir)
+    from core.raft_stereo import RAFTStereo as TorchRAFTStereo
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import create_model, init_model
+    from raft_stereo_tpu.utils.checkpoint_convert import (
+        convert_state_dict, validate_against_variables)
+
+    torch.manual_seed(args.seed)
+    targs = argparse.Namespace(
+        hidden_dims=[128, 128, 128], corr_implementation="reg",
+        shared_backbone=False, corr_levels=4, corr_radius=4, n_downsample=2,
+        context_norm="batch", slow_fast_gru=False, n_gru_layers=3,
+        mixed_precision=False)
+    tmodel = TorchRAFTStereo(targs)
+
+    # --- short torch training on synthetic pairs (BN stats updating) -------
+    rng = np.random.default_rng(args.seed)
+    th, tw = args.train_size
+    tmodel.train()  # NO freeze_bn: running stats must move
+    opt = torch.optim.AdamW(tmodel.parameters(), lr=2e-4, weight_decay=1e-5)
+    t0 = time.time()
+    for step in range(args.train_steps):
+        i1, i2, d = make_pair(rng, th, tw)
+        im1 = torch.from_numpy(i1.transpose(2, 0, 1))[None]
+        im2 = torch.from_numpy(i2.transpose(2, 0, 1))[None]
+        flow_gt = torch.from_numpy(-d)[None, None]  # flow-x = -disparity
+        preds = tmodel(im1, im2, iters=args.train_iters)
+        gamma = 0.9 ** (15.0 / max(args.train_iters - 1, 1))
+        loss = sum((gamma ** (len(preds) - 1 - i)) *
+                   (pred[:, :1] - flow_gt).abs().mean()
+                   for i, pred in enumerate(preds))
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(tmodel.parameters(), 1.0)
+        opt.step()
+        if step % 25 == 0 or step == args.train_steps - 1:
+            print(f"torch train step {step:4d} loss {float(loss):.3f} "
+                  f"({time.time()-t0:.0f}s)", flush=True)
+    tmodel.eval()
+    sd = tmodel.state_dict()
+    rm = sd["cnet.norm1.running_mean"]
+    print(f"BN running stats moved: |mean| {float(rm.abs().mean()):.4f} "
+          f"(zero at init)", flush=True)
+    assert float(rm.abs().mean()) > 1e-3, "BN stats did not update"
+
+    # --- convert & evaluate both at full scale -----------------------------
+    cfg = RAFTStereoConfig()  # fp32 eval default (fp32 volume)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 128, 3))
+    converted = validate_against_variables(convert_state_dict(sd), variables)
+
+    variants = {
+        "fp32": create_model(cfg),
+        "bf16": create_model(RAFTStereoConfig(mixed_precision=True)),
+        "bf16+bf16vol": create_model(RAFTStereoConfig(
+            mixed_precision=True, corr_storage_dtype="bfloat16")),
+    }
+
+    eh, ew = args.eval_size
+    results = {k: [] for k in ["torch", *variants]}
+    for i in range(args.pairs):
+        i1, i2, d = make_pair(rng, eh, ew)
+        with torch.no_grad():
+            _, t_up = tmodel(
+                torch.from_numpy(i1.transpose(2, 0, 1))[None],
+                torch.from_numpy(i2.transpose(2, 0, 1))[None],
+                iters=args.eval_iters, test_mode=True)
+        results["torch"].append(epe(-t_up.numpy()[0, 0], d))
+        for name, m in variants.items():
+            _, j_up = m.apply(converted, jnp.asarray(i1)[None],
+                              jnp.asarray(i2)[None],
+                              iters=args.eval_iters, test_mode=True)
+            results[name].append(epe(-np.asarray(j_up)[0, ..., 0], d))
+        print(f"pair {i}: torch EPE {results['torch'][-1]:.4f}  " +
+              "  ".join(f"{k} {results[k][-1]:.4f}" for k in variants),
+              flush=True)
+
+    t_epe = float(np.mean(results["torch"]))
+    print(f"\nmean EPE over {args.pairs} pairs at {eh}x{ew}/"
+          f"{args.eval_iters} iters:")
+    rel = {}
+    for k in variants:
+        j_epe = float(np.mean(results[k]))
+        rel[k] = abs(j_epe - t_epe) / max(t_epe, 1e-9)
+        print(f"  torch {t_epe:.4f} vs {k:13s} {j_epe:.4f}  "
+              f"rel-dev {100*rel[k]:.3f}%")
+
+    if rel["fp32"] > args.tolerance:
+        print(f"FAIL: fp32 relative EPE deviation {100*rel['fp32']:.3f}% "
+              f"> {100*args.tolerance:.1f}%")
+        return 1
+    print(f"PASS: fp32 within {100*args.tolerance:.1f}% of the torch "
+          f"baseline (bf16 deltas reported above are informational)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
