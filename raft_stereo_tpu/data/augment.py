@@ -38,8 +38,15 @@ cv2.ocl.setUseOpenCL(False)
 
 # ------------------------------------------------------------------ photometric
 
-def _blend(a: np.ndarray, b: np.ndarray, factor: float) -> np.ndarray:
-    return np.clip(factor * a + (1.0 - factor) * b, 0.0, 255.0)
+def _blend(a: np.ndarray, b, factor: float) -> np.ndarray:
+    """``clip(f*a + (1-f)*b)`` with minimal temporaries; ``b`` may be a
+    scalar or a broadcastable array."""
+    out = np.multiply(a, np.float32(factor), dtype=np.float32)
+    if isinstance(b, np.ndarray):
+        out += (1.0 - factor) * b
+    elif b:
+        out += np.float32((1.0 - factor) * b)
+    return np.clip(out, 0.0, 255.0, out=out)
 
 
 def _grayscale(img: np.ndarray) -> np.ndarray:
@@ -48,17 +55,17 @@ def _grayscale(img: np.ndarray) -> np.ndarray:
 
 
 def adjust_brightness(img: np.ndarray, factor: float) -> np.ndarray:
-    return _blend(img, np.zeros_like(img), factor)
+    return _blend(img, 0.0, factor)
 
 
 def adjust_contrast(img: np.ndarray, factor: float) -> np.ndarray:
-    mean = _grayscale(img).mean()
-    return _blend(img, np.full_like(img, mean), factor)
+    mean = float(_grayscale(img).mean())
+    return _blend(img, mean, factor)
 
 
 def adjust_saturation(img: np.ndarray, factor: float) -> np.ndarray:
     gray = _grayscale(img)[..., None]
-    return _blend(img, np.broadcast_to(gray, img.shape), factor)
+    return _blend(img, gray, factor)
 
 
 def adjust_hue(img: np.ndarray, shift: float) -> np.ndarray:
@@ -69,7 +76,13 @@ def adjust_hue(img: np.ndarray, shift: float) -> np.ndarray:
 
 
 def adjust_gamma(img: np.ndarray, gamma: float, gain: float = 1.0) -> np.ndarray:
-    return np.clip(255.0 * gain * (img / 255.0) ** gamma, 0.0, 255.0)
+    if gamma == 1.0:
+        out = np.multiply(img, np.float32(gain), dtype=np.float32)
+        return np.clip(out, 0.0, 255.0, out=out)
+    out = np.multiply(img, np.float32(1.0 / 255.0), dtype=np.float32)
+    np.power(out, np.float32(gamma), out=out)
+    out *= np.float32(255.0 * gain)
+    return np.clip(out, 0.0, 255.0, out=out)
 
 
 class PhotometricAugment:
@@ -102,8 +115,12 @@ class PhotometricAugment:
         for i in rng.permutation(4):
             out = ops[i](out)
         g_min, g_max, gain_min, gain_max = self.gamma
-        out = adjust_gamma(out, rng.uniform(g_min, g_max),
-                           rng.uniform(gain_min, gain_max))
+        # the RNG draws must happen unconditionally to keep the deterministic
+        # stream identical whether or not the gamma op is an identity
+        gamma = rng.uniform(g_min, g_max)
+        gain = rng.uniform(gain_min, gain_max)
+        if not (gamma == 1.0 and gain == 1.0):
+            out = adjust_gamma(out, gamma, gain)
         return out.astype(np.uint8)
 
 

@@ -57,10 +57,14 @@ class StereoPredictor:
         self._mesh = None
         self._w_divis = PAD_DIVIS
         if cfg.corr_implementation == "ring" and len(jax.devices()) > 1:
+            import math
+
             from raft_stereo_tpu.parallel.mesh import make_mesh
             n = len(jax.devices())
             self._mesh = make_mesh(1, n)
-            self._w_divis = max(
+            # both constraints must hold: /32 model downsampling AND local
+            # per-shard pyramid pooling -> lcm, not max
+            self._w_divis = math.lcm(
                 PAD_DIVIS, cfg.factor * n * 2 ** (cfg.corr_levels - 1))
 
     def _forward(self, shape: Tuple[int, int, int], iters: int):
@@ -90,15 +94,14 @@ class StereoPredictor:
             target=(bucket_size(h, PAD_DIVIS, self.bucket),
                     bucket_size(w, self._w_divis, self.bucket)))
         im1, im2 = padder.pad(image1, image2)
+        import contextlib
+        ctx = self._mesh if self._mesh is not None else contextlib.nullcontext()
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
             spec = NamedSharding(self._mesh, P(None, None, SEQ_AXIS, None))
             im1, im2 = jax.device_put(im1, spec), jax.device_put(im2, spec)
-            with self._mesh:
-                fn = self._forward(tuple(im1.shape[:3]), iters)
-                _, flow_up = fn(self.variables, im1, im2)
-        else:
+        with ctx:
             fn = self._forward(tuple(im1.shape[:3]), iters)
             _, flow_up = fn(self.variables, im1, im2)
         return np.asarray(padder.unpad(flow_up))

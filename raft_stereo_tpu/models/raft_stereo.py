@@ -256,7 +256,6 @@ class RAFTStereo(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
-            unroll=cfg.scan_unroll,
         )(cfg, test_mode, fused, deferred, dt, name="refinement")
         gt_and_mask = None
         if fused:

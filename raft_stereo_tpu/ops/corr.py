@@ -187,13 +187,8 @@ def _lookup_ring(state: CorrState, coords_x: jax.Array) -> jax.Array:
     mesh = _ambient_mesh()
     if (mesh is None or SEQ_AXIS not in mesh.axis_names
             or mesh.shape[SEQ_AXIS] == 1):
-        fmap2 = state.levels[0]
-        levels = [fmap2]
-        for _ in range(state.num_levels - 1):
-            levels.append(pool_w2(levels[-1]))
-        alt_state = CorrState(levels=tuple(levels), fmap1=state.fmap1,
-                              impl="alt", radius=state.radius,
-                              num_levels=state.num_levels)
+        alt_state = _build_alt(state.fmap1, state.levels[0],
+                               state.num_levels, state.radius)
         return _lookup_alt(alt_state, coords_x)
 
     from raft_stereo_tpu.parallel.ring_corr import make_ring_lookup

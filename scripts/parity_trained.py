@@ -59,9 +59,9 @@ def smooth_field(rng, h, w, channels, octaves=4, base=8):
 def make_pair(rng, h, w, max_disp=48.0):
     """(img1, img2, disparity) with img2 the GT-warped img1."""
     tex = smooth_field(rng, h, w, 3)
-    tex = (tex - tex.min()) / (tex.ptp() + 1e-6) * 255.0
+    tex = (tex - tex.min()) / (np.ptp(tex) + 1e-6) * 255.0
     d = smooth_field(rng, h, w, 1, octaves=3)
-    d = (d - d.min()) / (d.ptp() + 1e-6) * rng.uniform(0.3, 1.0) * max_disp
+    d = (d - d.min()) / (np.ptp(d) + 1e-6) * rng.uniform(0.3, 1.0) * max_disp
     # img2(x) = img1(x - d): sample img1 at x + d? No — disparity convention:
     # left pixel x matches right pixel x - d. We synthesize the RIGHT image
     # by sampling the left texture at x + d_right ~ x + d (approximate
@@ -71,7 +71,7 @@ def make_pair(rng, h, w, max_disp=48.0):
     x0 = np.clip(np.floor(xs), 0, w - 1).astype(np.int64)
     x1 = np.clip(x0 + 1, 0, w - 1)
     frac = np.clip(xs - x0, 0.0, 1.0)
-    rows = np.arange(h)[:, None, None]
+    rows = np.arange(h)[:, None]
     img2 = (tex[rows, x0[..., 0], :] * (1 - frac) +
             tex[rows, x1[..., 0], :] * frac)
     return tex.astype(np.float32), img2.astype(np.float32), d[..., 0]

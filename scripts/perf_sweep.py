@@ -90,11 +90,8 @@ def main():
                              remat_refinement=False),
         "reg/fp32-volume": dict(corr_implementation="reg",
                                 corr_storage_dtype="float32"),
-        "reg/deferred": dict(corr_implementation="reg",
-                             deferred_upsample=True),
-        "reg/deferred-unroll2": dict(corr_implementation="reg",
-                                     deferred_upsample=True, scan_unroll=2),
-        "reg/unroll2": dict(corr_implementation="reg", scan_unroll=2),
+        "reg/in-scan-upsample": dict(corr_implementation="reg",
+                                     deferred_upsample=False),
         "reg_pallas/full-remat": dict(corr_implementation="reg_pallas"),
         "alt/full-remat": dict(corr_implementation="alt"),
         "alt_pallas/full-remat": dict(corr_implementation="alt_pallas"),

@@ -113,9 +113,11 @@ def test_predictor_ring_matches_alt():
     left = rng.uniform(0, 255, (1, 32, 500, 3)).astype(np.float32)
     right = rng.uniform(0, 255, (1, 32, 500, 3)).astype(np.float32)
 
+    import math
     pred_ring = StereoPredictor(cfg_ring, variables, valid_iters=2)
     assert pred_ring._mesh is not None
-    assert pred_ring._w_divis == 4 * 8 * 8  # factor * n_devices * 2^(levels-1)
+    # lcm(pad_divis, factor * n_devices * 2^(levels-1))
+    assert pred_ring._w_divis == math.lcm(32, 4 * jax.device_count() * 8)
     pred_alt = StereoPredictor(cfg_alt, variables, valid_iters=2)
 
     got = pred_ring(left, right)
