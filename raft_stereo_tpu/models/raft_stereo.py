@@ -25,7 +25,13 @@ from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
 from raft_stereo_tpu.nn.gru import BasicMultiUpdateBlock
 from raft_stereo_tpu.nn.layers import Conv, ResidualBlock
 from raft_stereo_tpu.ops.corr import CorrState, corr_lookup, init_corr
-from raft_stereo_tpu.ops.geometry import coords_grid, upsample_disparity_convex
+from raft_stereo_tpu.ops.geometry import (
+    convex_upsample_tiles,
+    coords_grid,
+    image_to_upsample_tiles,
+    upsample_disparity_convex,
+    upsample_tiles_to_image,
+)
 
 Dtype = Any
 
@@ -91,7 +97,8 @@ class RefinementStep(nn.Module):
             return (net, coords1, mask.astype(jnp.float32)), None
         if self.deferred:
             # deferred-upsample: emit the low-res flow and (compute-dtype)
-            # mask; one batched upsample runs after the scan.
+            # mask; one batched upsample runs after the scan (and, in the
+            # fused-loss case, the loss is computed there in tile layout).
             return (net, coords1), ((coords1 - coords0)[..., :1], mask)
         flow_up = upsample_disparity_convex(coords1 - coords0,
                                             mask.astype(jnp.float32),
@@ -224,11 +231,12 @@ class RAFTStereo(nn.Module):
         if fused and loss_mask is None:
             raise ValueError("the fused-loss path needs both flow_gt and "
                              "loss_mask (see training.loss.loss_mask)")
+        deferred = cfg.deferred_upsample and not test_mode
         if test_mode:
             mask_ch = 9 * cfg.factor ** 2
             carry = (tuple(net_list), coords1,
                      jnp.zeros((b, h, w, mask_ch), jnp.float32))
-        elif fused:
+        elif fused and not deferred:
             carry = (tuple(net_list), coords1,
                      jnp.zeros((b, h * cfg.factor, w * cfg.factor, 1),
                                jnp.float32))
@@ -248,7 +256,6 @@ class RAFTStereo(nn.Module):
             body = nn.remat(RefinementStep, prevent_cse=False)
         else:
             body = RefinementStep
-        deferred = (cfg.deferred_upsample and not test_mode and not fused)
         step = nn.scan(
             body,
             variable_broadcast="params",
@@ -269,16 +276,33 @@ class RAFTStereo(nn.Module):
             flow_up = upsample_disparity_convex(coords1 - coords0, mask,
                                                 cfg.factor)
             return coords1 - coords0, flow_up
-        if fused:
-            return flow_predictions, carry[2]
         if deferred:
             lowres, masks = flow_predictions  # (it,B,h,w,1), (it,B,h,w,9f^2)
             it, bb, hp, wp = lowres.shape[:4]
-            up = upsample_disparity_convex(
+            tiles = convex_upsample_tiles(
                 lowres.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
                 masks.reshape(it * bb, hp, wp, -1).astype(jnp.float32),
-                cfg.factor)
+                cfg.factor)  # (it*B, h, w, f, f)
+            if fused:
+                # loss in tile layout: |pred - gt| summed over pixels is
+                # layout-invariant, so transpose the (B,H,W) GT/mask ONCE
+                # instead of the (iters*B,H,W) prediction stack, and emit
+                # only per-iteration masked L1 sums + the final prediction.
+                gt_t = image_to_upsample_tiles(
+                    flow_gt.astype(jnp.float32), cfg.factor)
+                mask_t = image_to_upsample_tiles(
+                    loss_mask.astype(jnp.float32), cfg.factor)
+                err = jnp.abs(tiles.reshape(it, bb, hp, wp,
+                                            cfg.factor, cfg.factor)
+                              - gt_t[None])
+                err = jnp.where(mask_t[None] > 0, err, 0.0)
+                err_sums = jnp.sum(err, axis=(1, 2, 3, 4, 5))
+                final_up = upsample_tiles_to_image(tiles[(it - 1) * bb:])
+                return err_sums, final_up
+            up = upsample_tiles_to_image(tiles)
             return up.reshape(it, bb, hp * cfg.factor, wp * cfg.factor, 1)
+        if fused:
+            return flow_predictions, carry[2]
         return flow_predictions
 
 
