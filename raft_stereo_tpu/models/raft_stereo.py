@@ -253,7 +253,18 @@ class RAFTStereo(nn.Module):
         # SLOWER than recompute — writing 22x residual slabs costs more HBM
         # traffic than the extra FLOPs (PERF.md experiment log).
         if cfg.remat_refinement:
-            body = nn.remat(RefinementStep, prevent_cse=False)
+            # Selective remat: save the fused GRU gate convs and the corr
+            # lookup output across the backward pass, recompute the rest.
+            # Measured optimum at the SceneFlow recipe with deferred-fused
+            # (PERF.md r2): 579.9 -> 544.9 ms/step vs full remat; broader
+            # save sets (head/motion hiddens) are slower again and the full
+            # tagged set OOMs. (Full remat was faster in r1 ONLY because the
+            # stacked path's memory pressure left no headroom — the
+            # deferred-fused path freed it.)
+            body = nn.remat(
+                RefinementStep, prevent_cse=False,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "gru_zr", "gru_q", "corr_feats"))
         else:
             body = RefinementStep
         step = nn.scan(
