@@ -29,12 +29,14 @@ from raft_stereo_tpu.training.state import TrainState, make_train_step
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 20.0
 
 
-def run_bench(batch, h, w, train_iters, steps, fused_loss=False):
+def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
+              remat_encoders=False):
     platform = jax.devices()[0].platform
     n_chips = jax.device_count()
 
     cfg = RAFTStereoConfig(mixed_precision=True,
-                           corr_storage_dtype="bfloat16")
+                           corr_storage_dtype="bfloat16",
+                           remat_encoders=remat_encoders)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 
@@ -121,6 +123,15 @@ def main():
                  fused_loss=True),
             dict(batch=8, h=320, w=720, train_iters=22, steps=6,
                  _note="stacked-loss fallback, same recipe"),
+            # The remote compile helper's failures are size-proportional:
+            # when the full batch-8 graph is rejected, walk down through
+            # smaller-footprint variants of the same recipe before shrinking
+            # the batch (throughput rises with batch, t(B) = fixed + k*B).
+            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
+                 fused_loss=True, remat_encoders=True,
+                 _note="encoder-remat fallback, same recipe"),
+            dict(batch=6, h=320, w=720, train_iters=22, steps=6,
+                 fused_loss=True, _note="reduced batch (6) fallback"),
             dict(batch=4, h=320, w=720, train_iters=22, steps=6,
                  fused_loss=True, _note="reduced batch fallback"),
             dict(batch=2, h=224, w=480, train_iters=22, steps=6,

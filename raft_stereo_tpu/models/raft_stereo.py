@@ -254,17 +254,21 @@ class RAFTStereo(nn.Module):
         # traffic than the extra FLOPs (PERF.md experiment log).
         if cfg.remat_refinement:
             # Selective remat: save the fused GRU gate convs and the corr
-            # lookup output across the backward pass, recompute the rest.
-            # Measured optimum at the SceneFlow recipe with deferred-fused
-            # (PERF.md r2): 579.9 -> 544.9 ms/step vs full remat; broader
-            # save sets (head/motion hiddens) are slower again and the full
-            # tagged set OOMs. (Full remat was faster in r1 ONLY because the
-            # stacked path's memory pressure left no headroom — the
-            # deferred-fused path freed it.)
-            body = nn.remat(
-                RefinementStep, prevent_cse=False,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "gru_zr", "gru_q", "corr_feats"))
+            # lookup output across the backward pass, recompute the rest —
+            # but only while the saved residuals fit comfortably: measured
+            # at the SceneFlow recipe (PERF.md r2), the policy is 579.9 ->
+            # 544.9 ms/step at batch 4 (1.1 GB saved) yet 1085 vs 879 ms at
+            # batch 8 (2.1 GB saved — HBM pressure inverts the trade). The
+            # estimate below is bf16 bytes of the saved names per step.
+            saved_ch = 3 * cfg.hidden_dims[2] + cfg.corr_channels
+            saved_bytes = iters * b * h * w * saved_ch * 2
+            if saved_bytes <= 1_600_000_000:
+                body = nn.remat(
+                    RefinementStep, prevent_cse=False,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "gru_zr", "gru_q", "corr_feats"))
+            else:
+                body = nn.remat(RefinementStep, prevent_cse=False)
         else:
             body = RefinementStep
         step = nn.scan(
