@@ -128,6 +128,9 @@ def main():
             # smaller-footprint variants of the same recipe before shrinking
             # the batch (throughput rises with batch, t(B) = fixed + k*B).
             dict(batch=8, h=320, w=720, train_iters=22, steps=6,
+                 fused_loss=True, remat_encoders="blocks",
+                 _note="encoder-block-remat fallback, same recipe"),
+            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
                  fused_loss=True, remat_encoders=True,
                  _note="encoder-remat fallback, same recipe"),
             dict(batch=6, h=320, w=720, train_iters=22, steps=6,

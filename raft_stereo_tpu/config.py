@@ -62,8 +62,11 @@ class RAFTStereoConfig:
     deferred_upsample: bool = True
     # Ours: rematerialize the encoders in the backward pass. Their
     # full-resolution conv1/layer1 activations are multi-GB backward
-    # residuals at train shapes; recompute costs one extra encoder forward.
-    remat_encoders: bool = False
+    # residuals at train shapes. True = recompute both whole encoders
+    # (one extra encoder forward); "blocks" = remat each trunk residual
+    # block individually (saves block inputs only — most of the memory win
+    # at a fraction of the recompute).
+    remat_encoders: "bool | str" = False
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
@@ -75,6 +78,10 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
+        if self.remat_encoders not in (False, True, "blocks"):
+            raise ValueError(
+                f"remat_encoders must be False, True or 'blocks', got "
+                f"{self.remat_encoders!r}")
         if self.corr_storage_dtype not in (None, "float32", "bfloat16"):
             raise ValueError(
                 f"unknown corr_storage_dtype {self.corr_storage_dtype!r}; "

@@ -161,7 +161,7 @@ class RAFTStereo(nn.Module):
         def _fnet_fwd(mdl, x):
             return mdl(x)
 
-        if cfg.remat_encoders:
+        if cfg.remat_encoders is True:
             # prevent_cse=True (default): at the top level of a jitted
             # function XLA CSE would otherwise merge the recomputed encoder
             # with the primal one and keep the residuals alive (inside the
@@ -169,11 +169,12 @@ class RAFTStereo(nn.Module):
             # it is not).
             _cnet_fwd = nn.remat(_cnet_fwd)
             _fnet_fwd = nn.remat(_fnet_fwd)
+        remat_blocks = cfg.remat_encoders == "blocks"
 
         cnet = MultiBasicEncoder(
             output_dim=(cfg.hidden_dims, cfg.hidden_dims),
             norm_fn=cfg.context_norm, downsample=cfg.n_downsample, dtype=dt,
-            name="cnet")
+            remat_blocks=remat_blocks, name="cnet")
         if cfg.shared_backbone:
             *cnet_list, trunk = _cnet_fwd(
                 cnet, jnp.concatenate([image1, image2], axis=0))
@@ -185,7 +186,7 @@ class RAFTStereo(nn.Module):
             cnet_list = _cnet_fwd(cnet, image1)
             fnet = BasicEncoder(output_dim=256, norm_fn="instance",
                                 downsample=cfg.n_downsample, dtype=dt,
-                                name="fnet")
+                                remat_blocks=remat_blocks, name="fnet")
             fmaps = _fnet_fwd(fnet,
                               jnp.concatenate([image1, image2], axis=0))
             fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)

@@ -23,26 +23,36 @@ Dtype = Any
 class _Trunk(nn.Module):
     """Shared stem + layer1-3 trunk used by both encoders (extractor.py:140-146
     stride pattern): conv1 stride ``2 if downsample>2``, layer2 ``2 if
-    downsample>1``, layer3 ``2 if downsample>0``."""
+    downsample>1``, layer3 ``2 if downsample>0``.
+
+    ``remat_blocks`` rematerializes each residual block in the backward pass
+    (``nn.remat`` on the block class — parameter paths unchanged): only block
+    INPUTS are saved, freeing the ~5 per-block full/half-resolution
+    activation tensors at the cost of recomputing two convs per block — the
+    middle ground between saving everything and recomputing both whole
+    encoders (``remat_encoders=True``).
+    """
 
     norm_fn: str
     downsample: int
     dtype: Optional[Dtype] = None
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x):
         d = self.dtype
+        RB = nn.remat(ResidualBlock) if self.remat_blocks else ResidualBlock
         x = Conv.make(64, 7, 1 + (self.downsample > 2), 3, d, "conv1")(x)
         x = apply_norm(make_norm(self.norm_fn, 64, num_groups=8, name="norm1"), x)
         x = nn.relu(x)
-        x = ResidualBlock(64, 64, self.norm_fn, 1, d, name="layer1_0")(x)
-        x = ResidualBlock(64, 64, self.norm_fn, 1, d, name="layer1_1")(x)
-        x = ResidualBlock(64, 96, self.norm_fn, 1 + (self.downsample > 1), d,
-                          name="layer2_0")(x)
-        x = ResidualBlock(96, 96, self.norm_fn, 1, d, name="layer2_1")(x)
-        x = ResidualBlock(96, 128, self.norm_fn, 1 + (self.downsample > 0), d,
-                          name="layer3_0")(x)
-        x = ResidualBlock(128, 128, self.norm_fn, 1, d, name="layer3_1")(x)
+        x = RB(64, 64, self.norm_fn, 1, d, name="layer1_0")(x)
+        x = RB(64, 64, self.norm_fn, 1, d, name="layer1_1")(x)
+        x = RB(64, 96, self.norm_fn, 1 + (self.downsample > 1), d,
+               name="layer2_0")(x)
+        x = RB(96, 96, self.norm_fn, 1, d, name="layer2_1")(x)
+        x = RB(96, 128, self.norm_fn, 1 + (self.downsample > 0), d,
+               name="layer3_0")(x)
+        x = RB(128, 128, self.norm_fn, 1, d, name="layer3_1")(x)
         return x
 
 
@@ -59,11 +69,13 @@ class BasicEncoder(nn.Module):
     downsample: int = 3
     dropout: float = 0.0
     dtype: Optional[Dtype] = None
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         d = self.dtype
-        x = _Trunk(self.norm_fn, self.downsample, d, name="trunk")(x)
+        x = _Trunk(self.norm_fn, self.downsample, d, self.remat_blocks,
+                   name="trunk")(x)
 
         x = Conv.make(self.output_dim, 1, 1, 0, d, "conv2")(x)
         if train and self.dropout > 0:
@@ -95,12 +107,14 @@ class MultiBasicEncoder(nn.Module):
     downsample: int = 3
     dropout: float = 0.0
     dtype: Optional[Dtype] = None
+    remat_blocks: bool = False
 
     @nn.compact
     def __call__(self, x, *, dual_inp: bool = False, num_layers: int = 3,
                  train: bool = False):
         d = self.dtype
-        x = _Trunk(self.norm_fn, self.downsample, d, name="trunk")(x)
+        x = _Trunk(self.norm_fn, self.downsample, d, self.remat_blocks,
+                   name="trunk")(x)
 
         if dual_inp:
             trunk = x
