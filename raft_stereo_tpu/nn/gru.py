@@ -56,10 +56,8 @@ def _split_input_conv(parts, kernel, bias, pad, dt):
     large spatial sizes, as the plain concat conv at small ones."""
     h, w = parts[0].shape[1], parts[0].shape[2]
     if h * w < _SPLIT_CONV_MIN_AREA:
-        hx = jnp.concatenate([v.astype(dt) for v in parts], axis=-1)
-        return jax.lax.conv_general_dilated(
-            hx, kernel, (1, 1), ((pad, pad), (pad, pad)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+        # degenerate to one concat conv via the same loop below
+        parts = [jnp.concatenate([v.astype(dt) for v in parts], axis=-1)]
     out = None
     off = 0
     for v in parts:

@@ -198,3 +198,29 @@ class TestTorchParamParity:
         got = n_params(variables)
         assert got == want - unused, \
             f"param count {got} != reference used {want - unused}"
+
+
+def test_split_input_conv_paths_agree(monkeypatch):
+    """The split (per-part kernel slices) and concat gate-conv formulations
+    must agree — the area threshold only picks between them."""
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.nn import gru as gru_mod
+    from raft_stereo_tpu.nn.gru import ConvGRU
+
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.normal(size=(1, 10, 12, 16)), jnp.float32)
+    x1 = jnp.asarray(rng.normal(size=(1, 10, 12, 8)), jnp.float32)
+    x2 = jnp.asarray(rng.normal(size=(1, 10, 12, 4)), jnp.float32)
+    cz = cr = cq = jnp.zeros((1, 10, 12, 16), jnp.float32)
+
+    cell = ConvGRU(hidden_dim=16)
+    variables = cell.init(jax.random.PRNGKey(0), h, cz, cr, cq, x1, x2)
+
+    monkeypatch.setattr(gru_mod, "_SPLIT_CONV_MIN_AREA", 1)  # force split
+    split_out = cell.apply(variables, h, cz, cr, cq, x1, x2)
+    monkeypatch.setattr(gru_mod, "_SPLIT_CONV_MIN_AREA", 1 << 30)  # concat
+    concat_out = cell.apply(variables, h, cz, cr, cq, x1, x2)
+
+    np.testing.assert_allclose(np.asarray(split_out), np.asarray(concat_out),
+                               atol=1e-5, rtol=1e-5)
