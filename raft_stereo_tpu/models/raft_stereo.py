@@ -61,6 +61,7 @@ class RefinementStep(nn.Module):
     fused: bool = False
     deferred: bool = False
     dtype: Optional[Dtype] = None
+    fused_motion: bool = False
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
@@ -68,11 +69,16 @@ class RefinementStep(nn.Module):
         net, coords1 = carry[0], carry[1]
         coords1 = jax.lax.stop_gradient(coords1)
 
-        corr = corr_lookup(corr_state, coords1)
         flow = coords1 - coords0
-
         dt0 = self.dtype
-        corr = checkpoint_name(corr.astype(dt0) if dt0 else corr, "corr_feats")
+        if self.fused_motion:
+            # lookup + motion encoder run as one Pallas kernel inside the
+            # update block; no standalone corr tensor exists
+            corr = None
+        else:
+            corr = corr_lookup(corr_state, coords1)
+            corr = checkpoint_name(corr.astype(dt0) if dt0 else corr,
+                                   "corr_feats")
 
         cfg = self.cfg
         dt = self.dtype
@@ -85,7 +91,9 @@ class RefinementStep(nn.Module):
                         iter16=True, iter08=False, update=False)
         net, mask, delta_flow = block(
             net, inp_list, corr, flow.astype(dt) if dt else flow,
-            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2)
+            iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
+            corr_state=corr_state if self.fused_motion else None,
+            coords_x=coords1[..., 0] if self.fused_motion else None)
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
@@ -217,6 +225,21 @@ class RAFTStereo(nn.Module):
                                radius=cfg.corr_radius,
                                storage_dtype=storage_dt)
 
+        # Fused lookup+motion kernel: applicable only for volume-pyramid
+        # implementations whose shapes fit the kernel tiling (the check is
+        # static — shapes are known at trace time). Everything else keeps
+        # the unfused path with identical semantics.
+        use_fused_motion = False
+        # auto (None) resolves to OFF: the kernel is numerically verified
+        # but Mosaic's compile time for the full fused body is pathological
+        # on the current toolchain (see ops/pallas/motion_kernels.py STATUS)
+        want_fused = bool(cfg.fused_motion)
+        if want_fused and corr_state.impl in ("reg", "reg_pallas"):
+            from raft_stereo_tpu.ops.pallas.motion_kernels import (
+                fused_motion_applicable)
+            use_fused_motion = fused_motion_applicable(corr_state.levels,
+                                                       cfg.corr_radius)
+
         b, h, w, _ = net_list[0].shape
         coords0 = coords_grid(b, h, w)
         coords1 = coords_grid(b, h, w)
@@ -249,24 +272,38 @@ class RAFTStereo(nn.Module):
         # (~0.6 GB per conv buffer at the SceneFlow train shape, 22 iters) and
         # training OOMs on a 16 GB chip. Remat recomputes them from the carry
         # instead — the jax.checkpoint FLOPs-for-HBM trade.
-        # Full remat (no selective save policy): every selective policy tried
-        # (saving the GRU gate convs, the corr lookup, or both) measured
-        # SLOWER than recompute — writing 22x residual slabs costs more HBM
-        # traffic than the extra FLOPs (PERF.md experiment log).
         if cfg.remat_refinement:
             # Selective remat: save the fused GRU gate convs and the corr
             # lookup output across the backward pass, recompute the rest —
             # but only while the saved residuals fit comfortably: measured
             # at the SceneFlow recipe (PERF.md r2), the policy is 579.9 ->
-            # 544.9 ms/step at batch 4 (1.1 GB saved) yet 1085 vs 879 ms at
-            # batch 8 (2.1 GB saved — HBM pressure inverts the trade). The
-            # estimate below is bf16 bytes of the saved names per step.
-            saved_ch = 3 * cfg.hidden_dims[2] + cfg.corr_channels
-            saved_bytes = iters * b * h * w * saved_ch * 2
-            # 1.2 GB: covers the measured-good batch-4 point (1.06 GB);
-            # batch 6 (1.6 GB) is unproven and its larger graph is also
-            # likelier to hit the remote compiler's size limit.
-            if saved_bytes <= 1_200_000_000:
+            # 544.9 ms/step at batch 4 yet 1085 vs 879 ms at batch 8 (HBM
+            # pressure inverts the trade). The estimate sums the tagged
+            # tensors at every GRU level (gru_zr is 2x hidden, gru_q 1x, at
+            # 1/1, 1/4, 1/16 of the level-0 area) plus corr_feats, per
+            # slow_fast pre-pass, in the compute dtype's width.
+            per_px = 3.0 * cfg.hidden_dims[2] + cfg.corr_channels
+            if cfg.n_gru_layers >= 2:
+                per_px += 3.0 * cfg.hidden_dims[1] / 4
+            if cfg.n_gru_layers == 3:
+                per_px += 3.0 * cfg.hidden_dims[0] / 16
+            if cfg.slow_fast_gru:
+                if cfg.n_gru_layers == 3:
+                    per_px += 2 * 3.0 * cfg.hidden_dims[0] / 16
+                if cfg.n_gru_layers >= 2:
+                    per_px += 3.0 * cfg.hidden_dims[1] / 4
+            bytes_per = 2 if dt == jnp.bfloat16 else 4
+            saved_bytes = int(iters * b * h * w * per_px * bytes_per)
+            if use_fused_motion:
+                # no standalone corr tensor exists on the fused path; its
+                # backward recomputes from (volumes, coords) instead
+                saved_bytes -= iters * b * h * w * cfg.corr_channels * bytes_per
+            # 1.5 GB: covers the measured-good batch-4 bf16 point (1.36 GB
+            # under this estimate); batch 6 (2.0 GB) is unproven and its
+            # larger graph is also likelier to hit the remote compiler's
+            # size limit. fp32 configs halve the eligible batch, matching
+            # their doubled residual traffic.
+            if saved_bytes <= 1_500_000_000:
                 body = nn.remat(
                     RefinementStep, prevent_cse=False,
                     policy=jax.checkpoint_policies.save_only_these_names(
@@ -282,7 +319,8 @@ class RAFTStereo(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
-        )(cfg, test_mode, fused, deferred, dt, name="refinement")
+        )(cfg, test_mode, fused, deferred, dt,
+          fused_motion=use_fused_motion, name="refinement")
         gt_and_mask = None
         if fused:
             gt_and_mask = (flow_gt.astype(jnp.float32),

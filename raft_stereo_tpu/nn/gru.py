@@ -134,9 +134,9 @@ class ConvGRU(nn.Module):
         # activation tensor — whose layout copy showed up at ~1 ms/iteration
         # in profiles — never materializes.
         zr = _split_input_conv(parts, kernel, bias, p, dt)
-        # checkpoint_name tags here and below are identity markers kept for
-        # remat experiments; no shipped policy consumes them (every selective
-        # save policy measured slower than full remat, PERF.md).
+        # gru_zr/gru_q tags feed the size-conditional save policy in
+        # models/raft_stereo.py (save_only_these_names when the estimated
+        # residuals fit; full remat otherwise — PERF.md r2 inversion).
         zr = checkpoint_name(zr, "gru_zr")
         z, r = jnp.split(zr, 2, axis=-1)
         z = nn.sigmoid(z + cz)
@@ -193,8 +193,31 @@ class BasicMotionEncoder(nn.Module):
     dtype: Optional[Dtype] = None
 
     @nn.compact
-    def __call__(self, flow, corr):
+    def __call__(self, flow, corr, corr_state=None, coords_x=None):
         d = self.dtype
+        if corr_state is not None:
+            # Fused path: the corr lookup and all five convs run as one
+            # Pallas kernel (ops/pallas/motion_kernels.py). Params are
+            # declared here with the reference names/shapes so checkpoints
+            # map 1:1; only the x-column of convf1 reaches the kernel (same
+            # exact-gradient argument as the unfused branch below).
+            from raft_stereo_tpu.ops.pallas.motion_kernels import (
+                fused_corr_motion)
+            cc = self.cfg.corr_channels
+            kc1, bc1 = _ConvParams((1, 1), cc, 64, name="convc1")()
+            kc2, bc2 = _ConvParams((3, 3), 64, 64, name="convc2")()
+            kf1, bf1 = _ConvParams((7, 7), 2, 64, name="convf1")()
+            kf2, bf2 = _ConvParams((3, 3), 64, 64, name="convf2")()
+            ko, bo = _ConvParams((3, 3), 128, 126, name="conv")()
+            params = {
+                "c1_k": kc1.reshape(cc, 64), "c1_b": bc1,
+                "c2_k": kc2, "c2_b": bc2,
+                "f1_k": kf1[:, :, 0, :].reshape(49, 64), "f1_b": bf1,
+                "f2_k": kf2, "f2_b": bf2,
+                "o_k": ko, "o_b": bo,
+            }
+            return fused_corr_motion(corr_state.levels, coords_x, params,
+                                     corr_state.radius, d)
         cor = nn.relu(checkpoint_name(
             Conv.make(64, 1, 1, 0, d, "convc1")(corr), "motion_c1"))
         cor = nn.relu(checkpoint_name(
@@ -236,7 +259,7 @@ class BasicMultiUpdateBlock(nn.Module):
     @nn.compact
     def __call__(self, net: Tuple, inp: Tuple, corr=None, flow=None, *,
                  iter08: bool = True, iter16: bool = True, iter32: bool = True,
-                 update: bool = True):
+                 update: bool = True, corr_state=None, coords_x=None):
         cfg = self.cfg
         d = self.dtype
         hd = cfg.hidden_dims
@@ -253,7 +276,8 @@ class BasicMultiUpdateBlock(nn.Module):
                 net[1] = ConvGRU(hd[1], dtype=d, name="gru16")(
                     net[1], *inp[1], pool2x(net[0]))
         if iter08:
-            motion = BasicMotionEncoder(cfg, dtype=d, name="encoder")(flow, corr)
+            motion = BasicMotionEncoder(cfg, dtype=d, name="encoder")(
+                flow, corr, corr_state=corr_state, coords_x=coords_x)
             if cfg.n_gru_layers > 1:
                 net[0] = ConvGRU(hd[2], dtype=d, name="gru08")(
                     net[0], *inp[0], motion, interp_to(net[1], net[0]))

@@ -191,6 +191,21 @@ def _lookup_ring(state: CorrState, coords_x: jax.Array) -> jax.Array:
                                state.num_levels, state.radius)
         return _lookup_alt(alt_state, coords_x)
 
+    # "ring" composes with the auto-SPMD paths (pjit / jit-under-mesh),
+    # where make_ring_lookup's shard_map is the one manual region. Inside an
+    # ALREADY-manual region (a shard_map body, e.g. make_shardmap_train_step
+    # on a seq>1 mesh) nesting another shard_map fails at trace time and the
+    # body's locally-built coords grid would be in the wrong (local) frame —
+    # reject with an actionable error instead.
+    if SEQ_AXIS in getattr(mesh, "manual_axes", ()):
+        raise NotImplementedError(
+            "corr_implementation='ring' cannot run inside a shard_map body "
+            f"(axis {SEQ_AXIS!r} is already manual). Use the pjit data×seq "
+            "path (parallel.data_parallel.make_pjit_train_step) for "
+            "sequence-sharded training, or call "
+            "parallel.ring_corr.ring_corr_lookup directly with per-shard "
+            "maps and global coords.")
+
     from raft_stereo_tpu.parallel.ring_corr import make_ring_lookup
     ring = make_ring_lookup(mesh, radius=state.radius,
                             num_levels=state.num_levels)

@@ -1,0 +1,579 @@
+"""Fused refinement-cell Pallas kernels: corr lookup + motion encoder.
+
+The refinement scan's forward iteration spends its non-MXU time in the
+pyramid correlation lookup (~0.9 ms/iter at the train shape) and the motion
+encoder's thin convolutions + elementwise glue (core/update.py:64-85 composed
+with core/corr.py:127-146); the backward iteration pays the same again as
+remat recompute plus the lookup's scatter. This module fuses that whole
+sub-graph — 4-level windowed lookup, ``convc1/convc2/convf1/convf2/conv``,
+their biases/ReLUs and the flow concat — into ONE Pallas kernel per
+direction, with all intermediates VMEM-resident:
+
+* forward: one pass over the volume slab per row-block; emits the 128-channel
+  motion features directly.
+* backward (hand-written VJP): recomputes the intermediates in VMEM, walks
+  the transpose convs back to ``d_corr``, scatters the lookup gradient into
+  per-level ``d_volume`` (row-local, so blocks write disjoint rows), and
+  accumulates the five convs' weight/bias gradients across the grid into
+  resident VMEM accumulators. The model detaches ``coords1`` before the
+  lookup (models/raft_stereo.py RefinementStep, mirroring the reference's
+  per-iteration ``detach``, core/raft_stereo.py:109) and the flow input is
+  likewise derived from detached coords, so the only tensor gradient this
+  sub-graph owes is ``d_volume`` — the coords cotangent is structurally zero.
+
+Spatial tiling is rows-only. Each grid program sees THREE consecutive
+``hb``-row chunks of every input (the same array bound three times with
+shifted, edge-clamped block index maps) — the middle chunk is the rows the
+program owns, the outer two are its halo (the conv chain's receptive field
+is 5 rows < hb). Beyond-edge chunks clamp to a valid block and are then
+zeroed by the row-validity mask, which re-zeroes every activation anyway
+(ReLU of a positive bias is nonzero even on zero input), so the convs'
+zero-padding semantics hold without materializing padded inputs. Column
+padding is zero-fill shifts inside VMEM.
+
+On non-TPU backends the kernels run in interpreter mode, so the same code is
+unit-tested on CPU (tests/test_fused_motion.py).
+
+STATUS — experimental, opt-in only (``fused_motion=True``): the kernels are
+numerically verified (forward + hand-written VJP match the module
+composition to fp32 tolerance, tests/test_fused_motion*.py), but Mosaic's
+compile time for the COMBINED kernel is pathological on this toolchain:
+measured on v5e, a 6-conv chain at a 4320-row flat slab compiles in ~11 s
+and a single pyramid level's lookup in ~5 s, yet the full fused body (4
+lookup levels + 6 convs) exceeds 8+ minutes — superlinear in ops x slab
+size, not a hang in this code. Until that is resolved (smaller fused
+scopes, or a Mosaic fix), the default pipeline keeps the XLA lookup path;
+``fused_motion=None`` (auto) therefore resolves to OFF everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.ops.pallas.corr_kernels import _interpret
+
+# Receptive-field halo of the fused chain, in level-0 rows: the output conv
+# (3x3) needs cor2/flo2 at +-1, flo2 needs flo1 at +-2, flo1 (7x7 on flow)
+# needs flow at +-5; the corr branch needs corr at +-2. The halo is one full
+# hb-row chunk (hb >= 8 > 5), delivered as the neighbouring input blocks.
+_HALO_ROWS = 5
+
+# VMEM working-set budget per grid program (slabs + activations + weights).
+# Generous: Mosaic schedules liveness much tighter than the static estimate
+# in _pick_hb; the estimate only guards against clearly-oversized shapes.
+_VMEM_BUDGET = 48 * 1024 * 1024
+
+
+# The conv/elementwise chain runs ENTIRELY in a flattened 2-D ``(R*W, C)``
+# layout: one spatial shift is a sublane-axis slice/concat by
+# ``(u-1)*W + (v-1)`` plus a column-validity mask for the horizontal part
+# (a shift crossing a row boundary reads the adjacent row's edge pixel —
+# the mask restores the conv's zero padding). Keeping a single 2-D layout
+# end-to-end is what makes Mosaic compile this kernel: the 3-D
+# shift-then-reshape formulation (a relayout per conv tap, ~54 of them)
+# drove the TPU compiler into multi-minute layout assignment and was
+# measured 20x slower to compile on a 3-conv probe.
+
+
+def _shift2d(x, off):
+    """``out[p] = x[p + off]`` along the sublane axis, zero-filled."""
+    if off == 0:
+        return x
+    z = jnp.zeros_like(x[:abs(off)])
+    return (jnp.concatenate([x[off:], z], 0) if off > 0
+            else jnp.concatenate([z, x[:off]], 0))
+
+
+def _conv3x3_2d(x, k, w, colmasks, dt):
+    """3x3 same-padding conv on a flattened ``(R*W, Ci)`` slab."""
+    n, ci = x.shape
+    co = k.shape[-1]
+    acc = jnp.zeros((n, co), jnp.float32)
+    for u in range(3):
+        for v in range(3):
+            xs = _shift2d(x, (u - 1) * w + (v - 1))
+            if v != 1:
+                xs = xs * colmasks[v - 1].astype(xs.dtype)
+            acc = acc + jax.lax.dot_general(
+                xs, k[u, v].astype(dt),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    return acc
+
+
+def _conv3x3_2d_transpose(g, k, w, colmasks, dt):
+    """Data gradient of :func:`_conv3x3_2d`:
+    ``dx[p] = sum_{u,v} g[p - off_{u,v}] k[u,v]^T`` with the column mask
+    evaluated at the OUTPUT position (validity of the original read)."""
+    n, co = g.shape
+    ci = k.shape[2]
+    acc = jnp.zeros((n, ci), jnp.float32)
+    for u in range(3):
+        for v in range(3):
+            gs = _shift2d(g, -(u - 1) * w - (v - 1))
+            if v != 1:
+                gs = gs * colmasks[-(v - 1)].astype(gs.dtype)
+            acc = acc + jax.lax.dot_general(
+                gs, k[u, v].astype(dt),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    return acc
+
+
+def _fwd_taps3x3(x, w, colmasks):
+    """The 9 shifted/masked forward operands of :func:`_conv3x3_2d` (for
+    weight gradients: ``dk[u,v] = taps[u,v]^T @ g``)."""
+    taps = []
+    for u in range(3):
+        for v in range(3):
+            xs = _shift2d(x, (u - 1) * w + (v - 1))
+            if v != 1:
+                xs = xs * colmasks[v - 1].astype(xs.dtype)
+            taps.append(xs)
+    return taps
+
+
+def _flow_taps49(flow, w, col):
+    """The 49 shifted/masked ``(N, 1)`` taps of the 7x7 ``convf1`` on the
+    flattened 1-channel flow; tap ``(u, v)`` reads ``flow[r+u-3, c+v-3]``."""
+    taps = []
+    for u in range(7):
+        for v in range(7):
+            xs = _shift2d(flow, (u - 3) * w + (v - 3))
+            if v != 3:
+                ok = ((col + (v - 3) >= 0) & (col + (v - 3) < w))
+                xs = xs * ok.astype(xs.dtype)
+            taps.append(xs)
+    return taps
+
+
+def _convf1_2d(taps, f1_k):
+    """7x7 conv on the 1-channel flow as 49 rank-1 VPU multiply-adds
+    (``(N,1) * (1,64)`` broadcasts): one input channel makes the MXU
+    formulation pointless, and concatenating 49 shifted single-lane taps
+    trips Mosaic's concat layout rules."""
+    acc = None
+    for t, xs in enumerate(taps):
+        term = xs * f1_k[t][None, :]
+        acc = term if acc is None else acc + term
+    return acc.astype(jnp.float32)
+
+
+def _rotate_left_flat(v, amount, w2):
+    """Barrel rotate on the lane axis: ``v[:, i] <- v[:, (i+amount) % w2]``;
+    ``v (N, W2)``, ``amount (N, 1)`` int32 (flat-layout twin of
+    corr_kernels._rotate_left_by)."""
+    nbits = max(1, (w2 - 1).bit_length())
+    for kbit in range(nbits):
+        s = (1 << kbit) % w2
+        rolled = jnp.concatenate([v[:, s:], v[:, :s]], axis=1)
+        bit = (amount >> kbit) & 1
+        v = jnp.where(bit == 1, rolled, v)
+    return v
+
+
+def _extract_window_flat(vol, base, radius):
+    """Taps ``g[:, j] = vol[:, base + j]`` for j in [0, 2r+2), zero outside
+    [0, W2). ``vol (N, W2)``, ``base (N, 1)`` int32."""
+    w2 = vol.shape[-1]
+    k = 2 * radius + 1
+    amount = jax.lax.rem(jax.lax.rem(base, w2) + w2, w2)
+    rotated = _rotate_left_flat(vol, amount, w2)
+    g = rotated[:, :k + 1]
+    tap_idx = base + jax.lax.broadcasted_iota(jnp.int32,
+                                              (base.shape[0], k + 1), 1)
+    return jnp.where((tap_idx >= 0) & (tap_idx < w2), g,
+                     jnp.zeros_like(g))
+
+
+def _scatter_window_flat(dg, base, radius, w2):
+    """Inverse of :func:`_extract_window_flat`: place taps ``dg[:, j]`` at
+    ``out[:, base + j]`` (out-of-range taps dropped). ``dg (N, 2r+2)``."""
+    k = 2 * radius + 1
+    tap_idx = base + jax.lax.broadcasted_iota(jnp.int32,
+                                              (base.shape[0], k + 1), 1)
+    dg = jnp.where((tap_idx >= 0) & (tap_idx < w2), dg, jnp.zeros_like(dg))
+    dg_wide = jnp.pad(dg, ((0, 0), (0, w2 - (k + 1))))
+    amount = jax.lax.rem(jax.lax.rem(base, w2) + w2, w2)
+    inv = jax.lax.rem(w2 - amount, w2)
+    return _rotate_left_flat(dg_wide, inv, w2)
+
+
+def _lookup_flat(coords2, vols, radius, rowmask):
+    """Pyramid windowed lookup, all-flat: ``coords2 (N, 1)``, ``vols`` a list
+    of ``(N, W2_i)`` slabs -> fp32 ``(N, L*(2r+1))``."""
+    k = 2 * radius + 1
+    outs = []
+    for i, vol in enumerate(vols):
+        c = coords2 / (2 ** i)
+        base_f = jnp.floor(c)
+        frac = c - base_f
+        base = base_f.astype(jnp.int32) - radius
+        g = _extract_window_flat(vol, base, radius).astype(jnp.float32)
+        outs.append((1.0 - frac) * g[:, :k] + frac * g[:, 1:])
+    return jnp.concatenate(outs, axis=-1) * rowmask
+
+
+def _cat3(a, b, c):
+    return jnp.concatenate([a[0], b[0], c[0]], axis=0)
+
+
+def _slab_setup(ca, cb, cc, j, hb, h, w):
+    """Common flat-slab preliminaries: coords, masks, flow — all ``(N, .)``.
+
+    Slab position p is image (row, col) = ((j-1)*hb + p // w, p % w); edge
+    chunks hold clamped duplicates that the row mask zeroes.
+    """
+    coords2 = _cat3(ca, cb, cc)                # (N, 1) f32
+    n = coords2.shape[0]
+    pid = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    rows = (j - 1) * hb + pid // w
+    col = pid % w
+    rowmask = ((rows >= 0) & (rows < h)).astype(jnp.float32)  # (N, 1)
+    colmasks = {
+        s: ((col + s >= 0) & (col + s < w)).astype(jnp.float32)
+        for s in (-1, 1)
+    }
+    flow = (coords2 - col.astype(jnp.float32)) * rowmask       # (N, 1)
+    return coords2, n, col, rowmask, colmasks, flow
+
+
+def _fwd_kernel(radius, hb, h, w, dt, *refs):
+    (ca, cb, cc,
+     v0a, v0b, v0c, v1a, v1b, v1c, v2a, v2b, v2c, v3a, v3b, v3c,
+     c1_k, c1_b, c2_k, c2_b, f1_k, f1_b, f2_k, f2_b, o_k, o_b,
+     out_ref) = refs
+    j = pl.program_id(1)
+    vols = (_cat3(v0a, v0b, v0c), _cat3(v1a, v1b, v1c),
+            _cat3(v2a, v2b, v2c), _cat3(v3a, v3b, v3c))
+    coords2, n, col, rowmask, colmasks, flow = _slab_setup(
+        ca, cb, cc, j, hb, h, w)
+
+    corr = _lookup_flat(coords2, vols, radius, rowmask).astype(dt)
+
+    def act(acc, bias):
+        y = jax.nn.relu(acc + bias.astype(jnp.float32))
+        return (y * rowmask).astype(dt)
+
+    def mm(x, k):
+        return jax.lax.dot_general(
+            x, k.astype(dt), dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    cor1 = act(mm(corr, c1_k[...]), c1_b[...])
+    cor2 = act(_conv3x3_2d(cor1, c2_k[...], w, colmasks, dt), c2_b[...])
+
+    flo1 = act(_convf1_2d(_flow_taps49(flow, w, col), f1_k[...]), f1_b[...])
+    flo2 = act(_conv3x3_2d(flo1, f2_k[...], w, colmasks, dt), f2_b[...])
+
+    cat = jnp.concatenate([cor2, flo2], axis=-1)
+    out126 = act(_conv3x3_2d(cat, o_k[...], w, colmasks, dt), o_b[...])
+
+    motion = jnp.concatenate(
+        [out126, flow.astype(dt), jnp.zeros((n, 1), dt)], axis=-1)
+    out_ref[0] = motion[hb * w:2 * hb * w]
+
+
+def _bwd_kernel(radius, hb, h, w, dt, w2s, *refs):
+    (ca, cb, cc,
+     v0a, v0b, v0c, v1a, v1b, v1c, v2a, v2b, v2c, v3a, v3b, v3c,
+     ga, gb, gc,
+     c1_k, c1_b, c2_k, c2_b, f1_k, f1_b, f2_k, f2_b, o_k, o_b,
+     dv0_ref, dv1_ref, dv2_ref, dv3_ref,
+     dc1_k, dc1_b, dc2_k, dc2_b, df1_k, df1_b, df2_k, df2_b,
+     do_k, do_b) = refs
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when((b == 0) & (j == 0))
+    def _():
+        for ref in (dc1_k, dc1_b, dc2_k, dc2_b, df1_k, df1_b, df2_k, df2_b,
+                    do_k, do_b):
+            ref[...] = jnp.zeros_like(ref)
+
+    vols = (_cat3(v0a, v0b, v0c), _cat3(v1a, v1b, v1c),
+            _cat3(v2a, v2b, v2c), _cat3(v3a, v3b, v3c))
+    coords2, n, col, rowmask, colmasks, flow = _slab_setup(
+        ca, cb, cc, j, hb, h, w)
+    # interior rows: the middle chunk — the rows this block owns (dW
+    # partials must not double-count halo rows neighbouring blocks also see)
+    pid = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+    tloc = pid // w
+    interior = (((tloc >= hb) & (tloc < 2 * hb)).astype(jnp.float32)
+                * rowmask)
+
+    # ---- forward recompute (identical to _fwd_kernel) ----
+    corr = _lookup_flat(coords2, vols, radius, rowmask).astype(dt)
+
+    def pre_act(acc, bias):
+        return acc + bias.astype(jnp.float32)
+
+    def act(pre):
+        return (jax.nn.relu(pre) * rowmask).astype(dt)
+
+    def mm(x, k):
+        return jax.lax.dot_general(
+            x, k.astype(dt), dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    cor1_pre = pre_act(mm(corr, c1_k[...]), c1_b[...])
+    cor1 = act(cor1_pre)
+    cor2_pre = pre_act(_conv3x3_2d(cor1, c2_k[...], w, colmasks, dt),
+                       c2_b[...])
+    cor2 = act(cor2_pre)
+    taps49 = _flow_taps49(flow, w, col)
+    flo1_pre = pre_act(_convf1_2d(taps49, f1_k[...]), f1_b[...])
+    flo1 = act(flo1_pre)
+    flo2_pre = pre_act(_conv3x3_2d(flo1, f2_k[...], w, colmasks, dt),
+                       f2_b[...])
+    flo2 = act(flo2_pre)
+    cat = jnp.concatenate([cor2, flo2], axis=-1)
+    out_pre = pre_act(_conv3x3_2d(cat, o_k[...], w, colmasks, dt), o_b[...])
+
+    # ---- backward ----
+    g = _cat3(ga, gb, gc).astype(jnp.float32)      # (N, Co+2)
+    # the trailing flow channels carry no gradient obligation: flow is a
+    # function of detached coords only
+    co = o_k.shape[-1]
+    g_out = (g[:, :co] * (out_pre > 0) * rowmask).astype(dt)
+    g_out_i = (g_out.astype(jnp.float32) * interior).astype(dt)
+
+    def wgrad3x3(x, gi, dk_ref, db_ref):
+        for t, xs in enumerate(_fwd_taps3x3(x, w, colmasks)):
+            dk_ref[t // 3, t % 3] += jax.lax.dot_general(
+                xs, gi, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        db_ref[0] += jnp.sum(gi.astype(jnp.float32), axis=0)
+
+    wgrad3x3(cat, g_out_i, do_k, do_b)
+    d_cat = _conv3x3_2d_transpose(g_out, o_k[...], w, colmasks, dt)
+    d_cor2 = (d_cat[:, :64] * (cor2_pre > 0) * rowmask).astype(dt)
+    d_flo2 = (d_cat[:, 64:] * (flo2_pre > 0) * rowmask).astype(dt)
+    d_cor2_i = (d_cor2.astype(jnp.float32) * interior).astype(dt)
+    d_flo2_i = (d_flo2.astype(jnp.float32) * interior).astype(dt)
+
+    wgrad3x3(cor1, d_cor2_i, dc2_k, dc2_b)
+    wgrad3x3(flo1, d_flo2_i, df2_k, df2_b)
+
+    d_cor1 = (_conv3x3_2d_transpose(d_cor2, c2_k[...], w, colmasks, dt)
+              * (cor1_pre > 0) * rowmask).astype(dt)
+    d_flo1 = (_conv3x3_2d_transpose(d_flo2, f2_k[...], w, colmasks, dt)
+              * (flo1_pre > 0) * rowmask).astype(dt)
+    d_cor1_i = (d_cor1.astype(jnp.float32) * interior).astype(dt)
+    d_flo1_i = (d_flo1.astype(jnp.float32) * interior).astype(dt)
+
+    dc1_k[...] += jax.lax.dot_general(
+        corr, d_cor1_i, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc1_b[0] += jnp.sum(d_cor1_i.astype(jnp.float32), axis=0)
+    d_flo1_f = d_flo1_i.astype(jnp.float32)
+    for t, xs in enumerate(taps49):
+        # rank-1 weight grad: sum_p taps[t][p] * g[p, :]
+        df1_k[t, :] += jnp.sum(xs * d_flo1_f, axis=0)
+    df1_b[0] += jnp.sum(d_flo1_f, axis=0)
+
+    # lookup gradient: d_corr -> per-level window scatter, interior rows only
+    # (the lookup is row-local, so interior d_corr rows are complete and the
+    # per-block d_volume rows are disjoint)
+    d_corr = (jax.lax.dot_general(
+        d_cor1, c1_k[...].astype(dt),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * interior)       # (N, L*(2r+1))
+    k = 2 * radius + 1
+    for i, dv_ref in enumerate((dv0_ref, dv1_ref, dv2_ref, dv3_ref)):
+        c = coords2 / (2 ** i)
+        base_f = jnp.floor(c)
+        frac = c - base_f
+        base = base_f.astype(jnp.int32) - radius
+        ct = d_corr[:, i * k:(i + 1) * k]
+        zeros = jnp.zeros_like(ct[:, :1])
+        dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
+              + jnp.concatenate([zeros, frac * ct], axis=-1))
+        dv = _scatter_window_flat(dg, base, radius, w2s[i])
+        dv_ref[0] = dv[hb * w:2 * hb * w]
+
+
+def _param_tuple(params):
+    return (params["c1_k"], params["c1_b"], params["c2_k"], params["c2_b"],
+            params["f1_k"], params["f1_b"], params["f2_k"], params["f2_b"],
+            params["o_k"], params["o_b"])
+
+
+def _pick_hb(h: int, w: int, w2s, itemsize: int) -> int:
+    """Largest row-block whose 3-chunk slabs + activations fit the budget."""
+    import os
+
+    def lanes(n):
+        return -(-n // 128) * 128
+
+    forced = int(os.environ.get("RAFT_FUSED_MOTION_HB", "0"))
+    if forced:
+        # a row block must still cover the conv chain's receptive field:
+        # a forced hb <= _HALO_ROWS would silently corrupt block borders
+        return forced if (h % forced == 0 and forced > _HALO_ROWS) else 0
+    # hb=8 only: Mosaic's compile time grows superlinearly with the flat
+    # slab's sublane count (4320 rows ~6 s, 8640 rows >150 s — measured);
+    # larger row blocks hit that cliff
+    for hb in (8,):
+        if h % hb:
+            continue
+        hin = 3 * hb
+        slab = hin * w * sum(lanes(x) for x in w2s) * itemsize
+        # ~8 concurrently-live (hin, w, 128-lane) fp32 activation tensors
+        acts = hin * w * 128 * 4 * 8
+        if slab + acts <= _VMEM_BUDGET:
+            return hb
+    return 0
+
+
+def _halo_specs(nb, shapes):
+    """Three Blocked specs per array: chunks j-1, j, j+1 (edge-clamped)."""
+    specs = []
+    for shp in shapes:
+        nd = len(shp)
+        for k in (-1, 0, 1):
+            specs.append(pl.BlockSpec(
+                shp,
+                functools.partial(
+                    lambda i, j, kk, nd_: (i, jnp.clip(j + kk, 0, nb - 1))
+                    + (0,) * (nd_ - 2), kk=k, nd_=nd)))
+    return specs
+
+
+def fused_motion_applicable(levels: Sequence[jax.Array], radius: int) -> bool:
+    """Static check: shapes fit the kernel's tiling and VMEM budget (the
+    backward's footprint — roughly double the forward's — is the binding
+    constraint)."""
+    if len(levels) != 4:
+        return False
+    b, h, w, _ = levels[0].shape
+    w2s = tuple(v.shape[-1] for v in levels)
+    if any(v.shape[:3] != (b, h, w) for v in levels):
+        return False
+    if any(x <= 2 * radius + 2 for x in w2s):
+        return False
+    return _pick_hb(h, w, w2s, 2 * levels[0].dtype.itemsize) > 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_corr_motion(levels: Tuple[jax.Array, ...], coords_x: jax.Array,
+                      params: dict, radius: int, dt) -> jax.Array:
+    """Fused pyramid lookup + motion encoder.
+
+    Args:
+      levels: 4-level correlation volume pyramid, each ``(B, H, W1, W2_i)``
+        (the ``reg`` CorrState, ops/corr.py:59-73).
+      coords_x: ``(B, H, W1)`` lookup centers in level-0 pixels (detached by
+        the caller; this function returns a zero coords cotangent).
+      params: dict of the five conv kernels/biases —
+        ``c1_k (36, 64)``, ``c2_k (3,3,64,64)``, ``f1_k (49, 64)`` (the 7x7
+        x-channel kernel, flattened taps), ``f2_k (3,3,64,64)``,
+        ``o_k (3,3,128,126)`` and biases; fp32 (cast to ``dt`` in-kernel).
+      dt: compute dtype (the model's mixed-precision policy).
+
+    Returns:
+      ``(B, H, W1, Co+2)`` motion features in ``dt``: channels [0, Co) are
+      the encoder output, Co is the flow x-component, Co+1 is zero (the
+      structurally-zero flow y, update.py:85).
+    """
+    return _fcm_fwd(levels, coords_x, params, radius, dt)[0]
+
+
+def _fcm_fwd(levels, coords_x, params, radius, dt):
+    dt = jnp.dtype(dt) if dt is not None else jnp.float32
+    b, h, w, _ = levels[0].shape
+    w2s = tuple(v.shape[-1] for v in levels)
+    vdt = levels[0].dtype
+    hb = _pick_hb(h, w, w2s, vdt.itemsize)
+    if hb == 0:
+        raise ValueError("fused_corr_motion: shapes unsupported; gate on "
+                         "fused_motion_applicable() first")
+    nb = h // hb
+    pt = _param_tuple(params)
+    nch = params["o_k"].shape[-1] + 2
+    # flatten spatial dims OUTSIDE the kernel (free layout-compatible
+    # reshapes in XLA): Mosaic rejects/struggles with in-kernel shape casts
+    coords_f = coords_x.astype(jnp.float32).reshape(b, h * w, 1)
+    levels_f = [lv.reshape(b, h * w, x) for lv, x in zip(levels, w2s)]
+    in_specs = (_halo_specs(nb, [(1, hb * w, 1)])
+                + _halo_specs(nb, [(1, hb * w, x) for x in w2s])
+                + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 10)
+    operands = ([coords_f] * 3
+                + [v for lv in levels_f for v in (lv, lv, lv)]
+                + list(pt))
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, radius, hb, h, w, dt),
+        grid=(b, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, hb * w, nch), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h * w, nch), dt),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(*operands)
+    return out.reshape(b, h, w, nch), (levels, coords_x, params)
+
+
+def _fcm_bwd(radius, dt, res, g):
+    dt = jnp.dtype(dt) if dt is not None else jnp.float32
+    levels, coords_x, params = res
+    b, h, w, _ = levels[0].shape
+    w2s = tuple(v.shape[-1] for v in levels)
+    vdt = levels[0].dtype
+    # the backward additionally holds the g slab and fp32 d_vol slabs;
+    # budget on twice the element size (mirrors fused_motion_applicable)
+    hb = _pick_hb(h, w, w2s, 2 * vdt.itemsize)
+    if hb == 0:
+        raise ValueError("fused_corr_motion backward: shapes exceed the "
+                         "kernel budget; gate on fused_motion_applicable() "
+                         "(which checks the backward footprint) first")
+    nb = h // hb
+    pt = _param_tuple(params)
+    nch = params["o_k"].shape[-1] + 2
+    coords_f = coords_x.astype(jnp.float32).reshape(b, h * w, 1)
+    levels_f = [lv.reshape(b, h * w, x) for lv, x in zip(levels, w2s)]
+    g_f = g.astype(dt).reshape(b, h * w, nch)
+    in_specs = (_halo_specs(nb, [(1, hb * w, 1)])
+                + _halo_specs(nb, [(1, hb * w, x) for x in w2s])
+                + _halo_specs(nb, [(1, hb * w, nch)])
+                + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 10)
+    operands = ([coords_f] * 3
+                + [v for lv in levels_f for v in (lv, lv, lv)]
+                + [g_f] * 3
+                + list(pt))
+    out_shapes = [jax.ShapeDtypeStruct((b, h * w, x), jnp.float32)
+                  for x in w2s]
+    pshapes = [jax.ShapeDtypeStruct(p.shape if p.ndim > 1 else (1,) + p.shape,
+                                    jnp.float32) for p in pt]
+    dvols_and_dps = pl.pallas_call(
+        functools.partial(_bwd_kernel, radius, hb, h, w, dt, w2s),
+        grid=(b, nb),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, hb * w, x), lambda i, j: (i, j, 0))
+                   for x in w2s]
+        + [pl.BlockSpec(s.shape, lambda i, j, n=len(s.shape): (0,) * n)
+           for s in pshapes],
+        out_shape=out_shapes + pshapes,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(*operands)
+    dvols = [dv.reshape(b, h, w, w2s[i]).astype(levels[i].dtype)
+             for i, dv in enumerate(dvols_and_dps[:4])]
+    dps = list(dvols_and_dps[4:])
+    names = ("c1_k", "c1_b", "c2_k", "c2_b", "f1_k", "f1_b", "f2_k", "f2_b",
+             "o_k", "o_b")
+    dparams = {}
+    for name, dp, p in zip(names, dps, pt):
+        dparams[name] = (dp.reshape(p.shape) if dp.shape != p.shape
+                         else dp).astype(p.dtype)
+    return (tuple(dvols), jnp.zeros_like(coords_x), dparams)
+
+
+fused_corr_motion.defvjp(_fcm_fwd, _fcm_bwd)

@@ -58,6 +58,16 @@ def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh):
 
 def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh):
     """Auto-SPMD dp+sp train step: jit with sharding-annotated inputs."""
+    import dataclasses
+
+    if getattr(model.cfg, "fused_motion", None):
+        # The fused lookup+motion Pallas kernel has no SPMD partitioning
+        # rule: under auto-SPMD it would force its operands replicated
+        # (gathering the full volume onto every device). The explicit
+        # shard_map DP path sees per-shard shapes and keeps the kernel;
+        # this path falls back to the unfused (identical-semantics) graph.
+        model = model.clone(
+            cfg=dataclasses.replace(model.cfg, fused_motion=False))
     step = make_train_step(model, tx, train_iters, axis_name=None)
     state_sharding = replicated(mesh)
     return jax.jit(

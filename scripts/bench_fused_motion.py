@@ -1,0 +1,126 @@
+"""Standalone TPU benchmark of the fused lookup+motion kernel vs the XLA path.
+
+Compares forward and forward+backward times at the SceneFlow train shape
+(level-0 grid 80x180), kernel vs the unfused composition, and prints ms per
+call. Also the quickest way to see whether Mosaic accepts the kernel's VMEM
+footprint at a given row-block choice.
+"""
+
+import argparse
+import time
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.nn.gru import BasicMotionEncoder
+from raft_stereo_tpu.ops.corr import CorrState, _lookup_reg
+from raft_stereo_tpu.ops.pallas.motion_kernels import (
+    fused_corr_motion,
+    fused_motion_applicable,
+)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--h", type=int, default=80)
+    p.add_argument("--w", type=int, default=180)
+    p.add_argument("--vol_dtype", default="bfloat16")
+    p.add_argument("--dt", default="bfloat16")
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+
+    vdt = jnp.dtype(args.vol_dtype)
+    dt = jnp.dtype(args.dt)
+    b, h, w = args.batch, args.h, args.w
+    w2s = [w, w // 2, w // 4, w // 8]
+    rng = np.random.default_rng(0)
+    levels = tuple(jnp.asarray(rng.standard_normal((b, h, w, x)), vdt)
+                   for x in w2s)
+    coords = jnp.asarray(rng.uniform(0, w, (b, h, w)), jnp.float32)
+    print("applicable:", fused_motion_applicable(levels, 4))
+
+    kp = {
+        "c1_k": jnp.asarray(rng.standard_normal((36, 64)) * .1, jnp.float32),
+        "c1_b": jnp.zeros((64,), jnp.float32),
+        "c2_k": jnp.asarray(rng.standard_normal((3, 3, 64, 64)) * .1,
+                            jnp.float32),
+        "c2_b": jnp.zeros((64,), jnp.float32),
+        "f1_k": jnp.asarray(rng.standard_normal((49, 64)) * .1, jnp.float32),
+        "f1_b": jnp.zeros((64,), jnp.float32),
+        "f2_k": jnp.asarray(rng.standard_normal((3, 3, 64, 64)) * .1,
+                            jnp.float32),
+        "f2_b": jnp.zeros((64,), jnp.float32),
+        "o_k": jnp.asarray(rng.standard_normal((3, 3, 128, 126)) * .1,
+                           jnp.float32),
+        "o_b": jnp.zeros((126,), jnp.float32),
+    }
+    flax_params = {
+        "convc1": {"kernel": kp["c1_k"].reshape(1, 1, 36, 64),
+                   "bias": kp["c1_b"]},
+        "convc2": {"kernel": kp["c2_k"], "bias": kp["c2_b"]},
+        "convf1": {"kernel": jnp.stack(
+            [kp["f1_k"].reshape(7, 7, 64),
+             jnp.zeros((7, 7, 64), jnp.float32)], axis=2),
+            "bias": kp["f1_b"]},
+        "convf2": {"kernel": kp["f2_k"], "bias": kp["f2_b"]},
+        "conv": {"kernel": kp["o_k"], "bias": kp["o_b"]},
+    }
+
+    col = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+    enc = BasicMotionEncoder(RAFTStereoConfig(), dtype=dt)
+
+    def xla_path(levels, coords, fp):
+        state = CorrState(levels=levels, fmap1=None, impl="reg", radius=4)
+        corr = _lookup_reg(state, coords).astype(dt)
+        flow = jnp.stack([coords - col, jnp.zeros_like(coords)],
+                         axis=-1).astype(dt)
+        return enc.apply({"params": fp}, flow, corr)
+
+    def kernel_path(levels, coords, kp):
+        return fused_corr_motion(levels, coords, kp, 4, dt)
+
+    probe = jnp.asarray(rng.standard_normal((b, h, w, 128)), jnp.float32)
+
+    def timed(fn, *a):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        # sync via scalar fetch (tunneled-device quirk)
+        float(jnp.sum(out if isinstance(out, jax.Array)
+                      else jax.tree.leaves(out)[0]))
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = fn(*a)
+        float(jnp.sum(out if isinstance(out, jax.Array)
+                      else jax.tree.leaves(out)[0]))
+        return (time.perf_counter() - t0) / args.steps * 1e3
+
+    for name, fn, pp in (("xla", xla_path, flax_params),
+                         ("kernel", kernel_path, kp)):
+        fwd = jax.jit(fn)
+        try:
+            t = timed(fwd, levels, coords, pp)
+            print(f"{name} fwd:      {t:8.3f} ms")
+        except Exception as e:
+            print(f"{name} fwd FAILED: {type(e).__name__} {str(e)[:200]}")
+            continue
+
+        def loss(levels, pp):
+            return jnp.sum(fn(levels, coords, pp) * probe)
+
+        bwd = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        try:
+            t = timed(lambda l, p_: bwd(l, p_), levels, pp)
+            print(f"{name} fwd+bwd:  {t:8.3f} ms")
+        except Exception as e:
+            print(f"{name} bwd FAILED: {type(e).__name__} {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

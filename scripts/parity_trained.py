@@ -62,11 +62,13 @@ def make_pair(rng, h, w, max_disp=48.0):
     tex = (tex - tex.min()) / (np.ptp(tex) + 1e-6) * 255.0
     d = smooth_field(rng, h, w, 1, octaves=3)
     d = (d - d.min()) / (np.ptp(d) + 1e-6) * rng.uniform(0.3, 1.0) * max_disp
-    # img2(x) = img1(x - d): sample img1 at x + d? No — disparity convention:
-    # left pixel x matches right pixel x - d. We synthesize the RIGHT image
-    # by sampling the left texture at x + d_right ~ x + d (approximate
-    # inverse warp with the same smooth field; GT stays exact for the left
-    # image by re-deriving d from the constructed correspondence).
+    # Disparity convention: left pixel x matches right pixel x - d. We
+    # synthesize the RIGHT image by sampling the left texture at x + d,
+    # using the LEFT-frame field d as an approximate inverse warp — the
+    # exact left-frame disparity at x' = x + d(x) is d(x), not d(x'), so
+    # the GT is approximate and absolute EPE is only indicative. The parity
+    # verdict is unaffected: both models are scored against the same field,
+    # and only the torch-vs-jax relative deviation gates.
     xs = np.arange(w, dtype=np.float32)[None, :, None] + d
     x0 = np.clip(np.floor(xs), 0, w - 1).astype(np.int64)
     x1 = np.clip(x0 + 1, 0, w - 1)

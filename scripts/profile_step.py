@@ -1,0 +1,170 @@
+"""Profile one train-step configuration and decompose scan vs fixed buckets.
+
+Captures a ``jax.profiler`` trace of the SceneFlow-recipe training step and
+splits device time into the refinement scans (the ``while`` ops: forward and
+backward) and the fixed bucket (everything else: encoders fwd+bwd, volume
+build, post-scan upsample/loss, optimizer), with per-op tops for each — the
+measurement that drives PERF.md's "path to 20 pairs/s" plan.
+
+Usage:
+    python scripts/profile_step.py --batch 4 --steps 3
+    python scripts/profile_step.py --batch 8 --remat_encoders blocks
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+from raft_stereo_tpu.utils.profiling import trace
+
+
+def load_events(log_dir):
+    paths = sorted(glob.glob(
+        os.path.join(log_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    data = json.load(gzip.open(paths[-1], "rt"))
+    events = data.get("traceEvents", [])
+    device_pids, op_tids = set(), set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            if "/device:" in e.get("args", {}).get("name", ""):
+                device_pids.add(e["pid"])
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            if e.get("args", {}).get("name") == "XLA Ops":
+                op_tids.add((e["pid"], e["tid"]))
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        if op_tids and (e["pid"], e.get("tid")) not in op_tids:
+            continue
+        out.append(e)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--h", type=int, default=320)
+    p.add_argument("--w", type=int, default=720)
+    p.add_argument("--iters", type=int, default=22)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--stacked", action="store_true",
+                   help="stacked-loss step instead of deferred-fused")
+    p.add_argument("--remat_encoders", default=False,
+                   help="False | True | blocks")
+    p.add_argument("--corr", default="reg")
+    p.add_argument("--top", type=int, default=14)
+    p.add_argument("--logdir", default="/tmp/profile_step")
+    args = p.parse_args()
+
+    remat_enc = {"False": False, "True": True}.get(
+        str(args.remat_encoders), args.remat_encoders)
+    cfg = RAFTStereoConfig(mixed_precision=True,
+                           corr_storage_dtype="bfloat16",
+                           corr_implementation=args.corr,
+                           remat_encoders=remat_enc)
+    tcfg = TrainConfig(batch_size=args.batch, train_iters=args.iters,
+                       num_steps=200000, image_size=(args.h, args.w))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg,
+                                  (1, args.h, args.w, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+    rng = jax.random.PRNGKey(1)
+    k1, k2, k3 = jax.random.split(rng, 3)
+    batch = {
+        "image1": jax.random.uniform(k1, (args.batch, args.h, args.w, 3),
+                                     jnp.float32) * 255,
+        "image2": jax.random.uniform(k2, (args.batch, args.h, args.w, 3),
+                                     jnp.float32) * 255,
+        "flow": -jax.random.uniform(k3, (args.batch, args.h, args.w, 1),
+                                    jnp.float32) * 50,
+        "valid": jnp.ones((args.batch, args.h, args.w), jnp.float32),
+    }
+    step = jax.jit(make_train_step(model, tx, args.iters,
+                                   fused_loss=not args.stacked),
+                   donate_argnums=(0,))
+    state, m = step(state, batch)
+    float(m["loss"])
+    state, m = step(state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    prev = None
+    for _ in range(args.steps):
+        state, m = step(state, batch)
+        if prev is not None:
+            float(prev["loss"])
+        prev = m
+    float(prev["loss"])
+    wall = (time.perf_counter() - t0) / args.steps
+
+    with trace(args.logdir):
+        prev = None
+        for _ in range(args.steps):
+            state, m = step(state, batch)
+            if prev is not None:
+                float(prev["loss"])
+            prev = m
+        float(prev["loss"])
+
+    events = load_events(args.logdir)
+    whiles = [e for e in events
+              if e.get("args", {}).get("hlo_category") == "while"]
+    leaves = [e for e in events
+              if e.get("args", {}).get("hlo_category") != "while"]
+    n = args.steps
+
+    spans = collections.defaultdict(float)
+    for e in whiles:
+        spans[e["name"]] += e["dur"]
+
+    def containing_while(e):
+        t = e["ts"]
+        for w in whiles:
+            if w["ts"] <= t and t + e.get("dur", 0) <= w["ts"] + w["dur"]:
+                return w["name"]
+        return None
+
+    buckets = collections.defaultdict(
+        lambda: (collections.Counter(), collections.Counter()))
+    meta = {}
+    for e in leaves:
+        key = containing_while(e) or "fixed (outside scans)"
+        t, c = buckets[key]
+        t[e["name"]] += e["dur"]
+        c[e["name"]] += 1
+        if e["name"] not in meta:
+            meta[e["name"]] = e.get("args", {}).get("long_name", "")[:110]
+
+    total_leaf = sum(e["dur"] for e in leaves) / 1e3 / n
+    print(f"wall/step: {wall * 1e3:.1f} ms   device-op total: "
+          f"{total_leaf:.1f} ms/step   (batch {args.batch}, "
+          f"{args.h}x{args.w}, iters {args.iters}, "
+          f"{'stacked' if args.stacked else 'fused'}, "
+          f"remat_enc={remat_enc})")
+    print("\nwhile spans (scan fwd/bwd):")
+    for name, dur in sorted(spans.items(), key=lambda kv: -kv[1]):
+        print(f"  {dur / 1e3 / n:9.2f} ms/step  {name}")
+    for key, (t, c) in sorted(buckets.items(),
+                              key=lambda kv: -sum(kv[1][0].values())):
+        print(f"\n{key}: {sum(t.values()) / 1e3 / n:.1f} ms/step")
+        for name, dur in t.most_common(args.top):
+            print(f"  {dur / 1e3 / n:9.2f} ms x{c[name] // n:<4d} "
+                  f"{name[:40]:40s} {meta[name][:70]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,212 @@
+"""Fused corr-lookup + motion-encoder kernel vs the module composition.
+
+Oracle: the exact XLA path the model takes without the kernel — ``_lookup_reg``
+on a reg CorrState followed by ``BasicMotionEncoder`` (nn/gru.py), sharing one
+parameter set. The kernels run in interpreter mode on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.nn.gru import BasicMotionEncoder
+from raft_stereo_tpu.ops.corr import CorrState, _lookup_reg
+from raft_stereo_tpu.ops.pallas.motion_kernels import (
+    fused_corr_motion,
+    fused_motion_applicable,
+)
+
+B, H, W = 1, 8, 24
+W2S = (96, 48, 24, 12)
+RADIUS = 4
+
+
+def make_inputs(seed=0, vol_dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    levels = tuple(
+        jnp.asarray(rng.standard_normal((B, H, W, w2)), vol_dtype)
+        for w2 in W2S)
+    coords = jnp.asarray(
+        rng.uniform(-4.0, W2S[0] + 4.0, (B, H, W)), jnp.float32)
+    return levels, coords
+
+
+def make_params(seed=1):
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return jnp.asarray(rng.standard_normal(shape) * 0.1, jnp.float32)
+
+    flax_params = {
+        "convc1": {"kernel": t(1, 1, 36, 64), "bias": t(64)},
+        "convc2": {"kernel": t(3, 3, 64, 64), "bias": t(64)},
+        "convf1": {"kernel": t(7, 7, 2, 64), "bias": t(64)},
+        "convf2": {"kernel": t(3, 3, 64, 64), "bias": t(64)},
+        "conv": {"kernel": t(3, 3, 128, 126), "bias": t(126)},
+    }
+    kp = {
+        "c1_k": flax_params["convc1"]["kernel"].reshape(36, 64),
+        "c1_b": flax_params["convc1"]["bias"],
+        "c2_k": flax_params["convc2"]["kernel"],
+        "c2_b": flax_params["convc2"]["bias"],
+        "f1_k": flax_params["convf1"]["kernel"][:, :, 0, :].reshape(49, 64),
+        "f1_b": flax_params["convf1"]["bias"],
+        "f2_k": flax_params["convf2"]["kernel"],
+        "f2_b": flax_params["convf2"]["bias"],
+        "o_k": flax_params["conv"]["kernel"],
+        "o_b": flax_params["conv"]["bias"],
+    }
+    return flax_params, kp
+
+
+def oracle_motion(levels, coords, flax_params, dt):
+    state = CorrState(levels=levels, fmap1=None, impl="reg", radius=RADIUS)
+    corr = _lookup_reg(state, coords)
+    if dt is not None:
+        corr = corr.astype(dt)
+    col = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+    flow = jnp.stack([coords - col, jnp.zeros_like(coords)], axis=-1)
+    if dt is not None:
+        flow = flow.astype(dt)
+    enc = BasicMotionEncoder(RAFTStereoConfig(), dtype=dt)
+    return enc.apply({"params": flax_params}, flow, corr)
+
+
+def test_applicable():
+    levels, _ = make_inputs()
+    assert fused_motion_applicable(levels, RADIUS)
+    assert not fused_motion_applicable(levels[:3], RADIUS)
+    tiny = tuple(v[..., : 2 * RADIUS + 1] for v in levels)
+    assert not fused_motion_applicable(tiny, RADIUS)
+
+
+@pytest.mark.parametrize("dt,tol", [(jnp.float32, 2e-4), (jnp.bfloat16, 5e-2)])
+def test_forward_matches_module(dt, tol):
+    levels, coords = make_inputs()
+    flax_params, kp = make_params()
+    want = np.asarray(oracle_motion(levels, coords, flax_params, dt),
+                      np.float32)
+    got = np.asarray(fused_corr_motion(levels, coords, kp, RADIUS, dt),
+                     np.float32)
+    assert got.shape == (B, H, W, 128)
+    # flow channels exactly
+    np.testing.assert_allclose(got[..., 126:], want[..., 126:],
+                               atol=1e-5)
+    scale = np.abs(want).max() + 1e-6
+    np.testing.assert_allclose(got / scale, want / scale, atol=tol)
+
+
+def test_forward_bf16_volume_storage():
+    levels, coords = make_inputs(vol_dtype=jnp.bfloat16)
+    flax_params, kp = make_params()
+    want = np.asarray(oracle_motion(levels, coords, flax_params,
+                                    jnp.bfloat16), np.float32)
+    got = np.asarray(
+        fused_corr_motion(levels, coords, kp, RADIUS, jnp.bfloat16),
+        np.float32)
+    scale = np.abs(want).max() + 1e-6
+    np.testing.assert_allclose(got / scale, want / scale, atol=5e-2)
+
+
+def test_multiblock_multibatch_grid():
+    """B=2, H=24 -> grid (2, 3): exercises the clamped halo chunks, the
+    interior-row weight-grad dedup, and the cross-grid-step accumulator
+    revisiting that the single-program shapes above never reach."""
+    b2, h2 = 2, 24
+    rng = np.random.default_rng(11)
+    levels = tuple(
+        jnp.asarray(rng.standard_normal((b2, h2, W, w2)), jnp.float32)
+        for w2 in W2S)
+    coords = jnp.asarray(
+        rng.uniform(-4.0, W2S[0] + 4.0, (b2, h2, W)), jnp.float32)
+    flax_params, kp = make_params(12)
+    probe = jnp.asarray(rng.standard_normal((b2, h2, W, 128)), jnp.float32)
+
+    def oracle(levels, fp):
+        state = CorrState(levels=levels, fmap1=None, impl="reg",
+                          radius=RADIUS)
+        corr = _lookup_reg(state, coords)
+        col = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+        flow = jnp.stack([coords - col, jnp.zeros_like(coords)], axis=-1)
+        enc = BasicMotionEncoder(RAFTStereoConfig(), dtype=None)
+        return enc.apply({"params": fp}, flow, corr)
+
+    got = np.asarray(
+        fused_corr_motion(levels, coords, kp, RADIUS, jnp.float32),
+        np.float32)
+    want = np.asarray(oracle(levels, flax_params), np.float32)
+    scale = np.abs(want).max() + 1e-6
+    np.testing.assert_allclose(got / scale, want / scale, atol=2e-4)
+
+    (dl_k, dkp) = jax.grad(
+        lambda l, p: jnp.sum(
+            fused_corr_motion(l, coords, p, RADIUS, jnp.float32) * probe),
+        argnums=(0, 1))(levels, kp)
+    (dl_o, dfp) = jax.grad(
+        lambda l, p: jnp.sum(oracle(l, p) * probe),
+        argnums=(0, 1))(levels, flax_params)
+    for i in range(4):
+        a, bb = np.asarray(dl_k[i]), np.asarray(dl_o[i])
+        s = np.abs(bb).max() + 1e-6
+        np.testing.assert_allclose(a / s, bb / s, atol=2e-4,
+                                   err_msg=f"d_volume level {i} (multiblock)")
+    pairs = [
+        (dkp["c2_k"], dfp["convc2"]["kernel"]),
+        (dkp["f1_k"].reshape(7, 7, 64), dfp["convf1"]["kernel"][:, :, 0, :]),
+        (dkp["o_k"], dfp["conv"]["kernel"]),
+        (dkp["o_b"], dfp["conv"]["bias"]),
+    ]
+    for nidx, (a, bb) in enumerate(pairs):
+        a, bb = np.asarray(a), np.asarray(bb)
+        s = np.abs(bb).max() + 1e-6
+        np.testing.assert_allclose(a / s, bb / s, atol=2e-4,
+                                   err_msg=f"param grad {nidx} (multiblock)")
+
+
+def test_gradients_match_module():
+    levels, coords = make_inputs()
+    flax_params, kp = make_params()
+    rng = np.random.default_rng(7)
+    probe = jnp.asarray(rng.standard_normal((B, H, W, 128)), jnp.float32)
+
+    def loss_kernel(levels, kp):
+        return jnp.sum(
+            fused_corr_motion(levels, coords, kp, RADIUS, jnp.float32)
+            * probe)
+
+    def loss_oracle(levels, fp):
+        return jnp.sum(
+            oracle_motion(levels, coords, fp, jnp.float32) * probe)
+
+    (dl_k, dkp) = jax.grad(loss_kernel, argnums=(0, 1))(levels, kp)
+    (dl_o, dfp) = jax.grad(loss_oracle, argnums=(0, 1))(levels, flax_params)
+
+    for i in range(4):
+        a, b = np.asarray(dl_k[i]), np.asarray(dl_o[i])
+        scale = np.abs(b).max() + 1e-6
+        np.testing.assert_allclose(a / scale, b / scale, atol=2e-4,
+                                   err_msg=f"d_volume level {i}")
+
+    pairs = [
+        (dkp["c1_k"].reshape(1, 1, 36, 64), dfp["convc1"]["kernel"]),
+        (dkp["c1_b"], dfp["convc1"]["bias"]),
+        (dkp["c2_k"], dfp["convc2"]["kernel"]),
+        (dkp["c2_b"], dfp["convc2"]["bias"]),
+        (dkp["f1_k"].reshape(7, 7, 64), dfp["convf1"]["kernel"][:, :, 0, :]),
+        (dkp["f1_b"], dfp["convf1"]["bias"]),
+        (dkp["f2_k"], dfp["convf2"]["kernel"]),
+        (dkp["f2_b"], dfp["convf2"]["bias"]),
+        (dkp["o_k"], dfp["conv"]["kernel"]),
+        (dkp["o_b"], dfp["conv"]["bias"]),
+    ]
+    for n, (a, b) in enumerate(pairs):
+        a, b = np.asarray(a), np.asarray(b)
+        scale = np.abs(b).max() + 1e-6
+        np.testing.assert_allclose(a / scale, b / scale, atol=2e-4,
+                                   err_msg=f"param grad {n}")
+    # the y-column of convf1 must receive zero gradient in the oracle
+    # (structurally-zero flow y), matching the kernel's omission of it
+    np.testing.assert_allclose(
+        np.asarray(dfp["convf1"]["kernel"][:, :, 1, :]), 0.0, atol=1e-6)
