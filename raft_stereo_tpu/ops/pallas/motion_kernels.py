@@ -416,7 +416,13 @@ def _pick_hb(h: int, w: int, w2s, itemsize: int) -> int:
     if forced:
         # a row block must still cover the conv chain's receptive field:
         # a forced hb <= _HALO_ROWS would silently corrupt block borders
-        return forced if (h % forced == 0 and forced > _HALO_ROWS) else 0
+        if h % forced == 0 and forced > _HALO_ROWS:
+            return forced
+        import warnings
+        warnings.warn(
+            f"RAFT_FUSED_MOTION_HB={forced} rejected (needs h % hb == 0 "
+            f"with h={h}, and hb > {_HALO_ROWS}); fused motion disabled")
+        return 0
     # hb=8 only: Mosaic's compile time grows superlinearly with the flat
     # slab's sublane count (4320 rows ~6 s, 8640 rows >150 s — measured);
     # larger row blocks hit that cliff

@@ -1,4 +1,5 @@
 from raft_stereo_tpu.parallel.data_parallel import (
+    dryrun_flagship_shape,
     dryrun_train_step,
     make_pjit_train_step,
     make_shardmap_train_step,

@@ -39,10 +39,18 @@ from raft_stereo_tpu.parallel.mesh import (
 from raft_stereo_tpu.training.state import TrainState, make_train_step
 
 
-def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh):
-    """Explicit-collective DP train step (state replicated, batch sharded on B)."""
+def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh,
+                             fused_loss: bool = False):
+    """Explicit-collective DP train step (state replicated, batch sharded on B).
+
+    ``fused_loss`` selects the in-scan/tile-layout loss (the fastest measured
+    step variant): per-shard error sums are already ``psum``-normalized
+    globally inside :func:`sequence_loss_fused` via ``axis_name``, so the
+    sharded step is identical math to the single-chip fused step.
+    """
     per_shard_step = make_train_step(model, tx, train_iters,
-                                     axis_name=DATA_AXIS)
+                                     axis_name=DATA_AXIS,
+                                     fused_loss=fused_loss)
 
     batch_spec = {"image1": P(DATA_AXIS), "image2": P(DATA_AXIS),
                   "flow": P(DATA_AXIS), "valid": P(DATA_AXIS)}
@@ -56,8 +64,14 @@ def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
-def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh):
-    """Auto-SPMD dp+sp train step: jit with sharding-annotated inputs."""
+def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
+                         fused_loss: bool = False):
+    """Auto-SPMD dp+sp train step: jit with sharding-annotated inputs.
+
+    ``fused_loss`` is written globally (no explicit collectives): the SPMD
+    partitioner turns the in-scan/tile-layout error reductions into the same
+    cross-device sums the stacked loss gets.
+    """
     import dataclasses
 
     if getattr(model.cfg, "fused_motion", None):
@@ -68,7 +82,8 @@ def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh):
         # this path falls back to the unfused (identical-semantics) graph.
         model = model.clone(
             cfg=dataclasses.replace(model.cfg, fused_motion=False))
-    step = make_train_step(model, tx, train_iters, axis_name=None)
+    step = make_train_step(model, tx, train_iters, axis_name=None,
+                           fused_loss=fused_loss)
     state_sharding = replicated(mesh)
     return jax.jit(
         step,
@@ -80,13 +95,20 @@ def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh):
 
 def dryrun_train_step(n_devices: int, seq_parallel: int = 2,
                       image_size=(32, 64), batch: int = 0,
-                      train_iters: int = 2) -> None:
+                      train_iters: int = 2, fused_loss: bool = True,
+                      run_shardmap: bool = True) -> None:
     """Compile + execute ONE full dp+sp training step on an n-device mesh.
 
     Used by the driver's multi-chip dry run (``__graft_entry__``): builds a
     ``(n_devices/seq_parallel, seq_parallel)`` mesh, shards batch over 'data'
     and width over 'seq', and runs both the pjit auto-SPMD step and the
-    explicit shard_map DP step on tiny shapes.
+    explicit shard_map DP step. Both run the fused (in-scan/tile-layout) loss
+    by default — the bench's primary recipe — so the sharded graph validated
+    here is the one a real multi-chip run would train with; stacked-loss
+    sharding is covered by the test suite.
+
+    The default shapes are a smoke run; ``dryrun_flagship_shape`` runs the
+    SceneFlow-proportioned shape (batch 8, 320x720).
     """
     import numpy as np
     import jax.numpy as jnp
@@ -131,11 +153,15 @@ def dryrun_train_step(n_devices: int, seq_parallel: int = 2,
     with mesh:
         placed = shard_batch(mesh, batch_data)
         state_r = jax.device_put(fresh_state(), replicated(mesh))
-        pjit_step = make_pjit_train_step(model, tx, train_iters, mesh)
+        pjit_step = make_pjit_train_step(model, tx, train_iters, mesh,
+                                         fused_loss=fused_loss)
         new_state, metrics = pjit_step(state_r, placed)
         jax.block_until_ready(metrics)
-        print("pjit dp x sp step ok:",
+        print("pjit dp x sp step ok (fused_loss=%s):" % fused_loss,
               {k: float(v) for k, v in metrics.items()})
+
+    if not run_shardmap:
+        return
 
     # Path 2: explicit shard_map DP with psum'd gradients.
     mesh_dp = make_mesh(n_devices, 1, devices=devices[:n_devices])
@@ -144,8 +170,25 @@ def dryrun_train_step(n_devices: int, seq_parallel: int = 2,
         dp_batch = {k: jax.device_put(
             v, NamedSharding(mesh_dp, P(DATA_AXIS)))
             for k, v in batch_data.items()}
-        dp_step = make_shardmap_train_step(model, tx, train_iters, mesh_dp)
+        dp_step = make_shardmap_train_step(model, tx, train_iters, mesh_dp,
+                                           fused_loss=fused_loss)
         new_state2, metrics2 = dp_step(state2, dp_batch)
         jax.block_until_ready(metrics2)
-        print("shard_map dp step ok:",
+        print("shard_map dp step ok (fused_loss=%s):" % fused_loss,
               {k: float(v) for k, v in metrics2.items()})
+
+
+def dryrun_flagship_shape(n_devices: int, seq_parallel: int = 2,
+                          train_iters: int = 2) -> None:
+    """dp x sp dry run at the SceneFlow-proportioned shape: batch 8, 320x720.
+
+    The smoke-shape dryrun proves the sharded step compiles; this proves the
+    FLAGSHIP-shaped graph does — batch 8 over 'data', the 720-px width over
+    'seq' — with the fused loss, i.e. the exact recipe bench.py reports.
+    ``train_iters`` stays small because refinement iterations only repeat the
+    (already validated) scan body; shape-dependent sharding is what varies.
+    """
+    dryrun_train_step(n_devices, seq_parallel=seq_parallel,
+                      image_size=(320, 720), batch=8,
+                      train_iters=train_iters, fused_loss=True,
+                      run_shardmap=False)

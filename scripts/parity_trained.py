@@ -95,6 +95,9 @@ def main():
     p.add_argument("--seed", type=int, default=11)
     p.add_argument("--tolerance", type=float, default=0.01,
                    help="max relative EPE deviation vs torch (1%% default)")
+    p.add_argument("--realtime_steps", type=int, default=120,
+                   help="torch training steps for the realtime-preset "
+                        "parity phase (0 skips it)")
     args = p.parse_args()
 
     import jax
@@ -153,8 +156,23 @@ def main():
     model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 128, 3))
     converted = validate_against_variables(convert_state_dict(sd), variables)
 
-    variants = {
+    # Gated variants (fp32): the default XLA path AND the Pallas kernels the
+    # TPU presets actually select (reg_pallas: windowed lookup kernel;
+    # alt_pallas: fused build+lookup — reference semantics core/corr.py:31-61
+    # and :64-107). On CPU the Pallas kernels execute in interpreter mode —
+    # the same kernel code path the TPU compiles. bf16 variants reported,
+    # not gated.
+    gated = {
         "fp32": create_model(cfg),
+        "fp32+reg_pallas": create_model(RAFTStereoConfig(
+            corr_implementation="reg_pallas",
+            corr_storage_dtype="float32")),
+        "fp32+alt_pallas": create_model(RAFTStereoConfig(
+            corr_implementation="alt_pallas",
+            corr_storage_dtype="float32")),
+    }
+    variants = {
+        **gated,
         "bf16": create_model(RAFTStereoConfig(mixed_precision=True)),
         "bf16+bf16vol": create_model(RAFTStereoConfig(
             mixed_precision=True, corr_storage_dtype="bfloat16")),
@@ -189,12 +207,135 @@ def main():
         print(f"  torch {t_epe:.4f} vs {k:13s} {j_epe:.4f}  "
               f"rel-dev {100*rel[k]:.3f}%")
 
-    if rel["fp32"] > args.tolerance:
-        print(f"FAIL: fp32 relative EPE deviation {100*rel['fp32']:.3f}% "
-              f"> {100*args.tolerance:.1f}%")
+    failed = [k for k in gated if rel[k] > args.tolerance]
+    if failed:
+        for k in failed:
+            print(f"FAIL: {k} relative EPE deviation {100*rel[k]:.3f}% "
+                  f"> {100*args.tolerance:.1f}%")
         return 1
-    print(f"PASS: fp32 within {100*args.tolerance:.1f}% of the torch "
-          f"baseline (bf16 deltas reported above are informational)")
+    print(f"PASS: {', '.join(gated)} within {100*args.tolerance:.1f}% of "
+          f"the torch baseline (bf16 deltas reported above are "
+          f"informational)")
+
+    if args.realtime_steps > 0:
+        rc = realtime_parity(args, make_pair, epe)
+        if rc:
+            return rc
+    return 0
+
+
+def realtime_parity(args, make_pair, epe):
+    """Trained-scale parity for the shared-backbone realtime preset
+    (README.md:105: shared_backbone, n_downsample 3, n_gru_layers 2,
+    slow_fast_gru, 7 iters). Trains a separate torch model with the realtime
+    architecture (corr 'reg' — the CPU-runnable oracle for reg_cuda), then
+    gates the converted jax model in fp32 with both the XLA 'reg' path and
+    the 'reg_pallas' kernel the TPU preset defaults to; the preset's own
+    bf16 numbers are reported, not gated."""
+    import argparse as _ap
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import create_model, init_model
+    from raft_stereo_tpu.utils.checkpoint_convert import (
+        convert_state_dict, validate_against_variables)
+    from core.raft_stereo import RAFTStereo as TorchRAFTStereo
+
+    print("\n--- realtime preset (shared backbone) parity ---", flush=True)
+    torch.manual_seed(args.seed + 1)
+    targs = _ap.Namespace(
+        hidden_dims=[128, 128, 128], corr_implementation="reg",
+        shared_backbone=True, corr_levels=4, corr_radius=4, n_downsample=3,
+        context_norm="batch", slow_fast_gru=True, n_gru_layers=2,
+        mixed_precision=False)
+    tmodel = TorchRAFTStereo(targs)
+
+    rng = np.random.default_rng(args.seed + 1)
+    th, tw = args.train_size
+    tmodel.train()
+    opt = torch.optim.AdamW(tmodel.parameters(), lr=2e-4, weight_decay=1e-5)
+    t0 = time.time()
+    for step in range(args.realtime_steps):
+        i1, i2, d = make_pair(rng, th, tw)
+        im1 = torch.from_numpy(i1.transpose(2, 0, 1))[None]
+        im2 = torch.from_numpy(i2.transpose(2, 0, 1))[None]
+        flow_gt = torch.from_numpy(-d)[None, None]
+        preds = tmodel(im1, im2, iters=args.train_iters)
+        gamma = 0.9 ** (15.0 / max(args.train_iters - 1, 1))
+        loss = sum((gamma ** (len(preds) - 1 - i)) *
+                   (pred[:, :1] - flow_gt).abs().mean()
+                   for i, pred in enumerate(preds))
+        opt.zero_grad()
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(tmodel.parameters(), 1.0)
+        opt.step()
+        if step % 25 == 0 or step == args.realtime_steps - 1:
+            print(f"torch realtime train step {step:4d} loss "
+                  f"{float(loss):.3f} ({time.time()-t0:.0f}s)", flush=True)
+    tmodel.eval()
+    sd = tmodel.state_dict()
+
+    base = dict(shared_backbone=True, n_downsample=3, n_gru_layers=2,
+                slow_fast_gru=True)
+    cfg = RAFTStereoConfig(**base)
+    _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 128, 3))
+    converted = validate_against_variables(convert_state_dict(sd), variables)
+
+    gated = {
+        "rt-fp32": create_model(cfg),
+        "rt-fp32+reg_pallas": create_model(RAFTStereoConfig(
+            **base, corr_implementation="reg_pallas",
+            corr_storage_dtype="float32")),
+    }
+    variants = {
+        **gated,
+        "rt-preset(bf16+reg_pallas)": create_model(RAFTStereoConfig(
+            **base, corr_implementation="reg_pallas",
+            mixed_precision=True)),
+    }
+
+    # realtime runs 7 iterations at 1/8 res; eval size must divide the
+    # n_downsample=3 pyramid (x32 with the 2-level GRU's /16... use /32)
+    eh, ew = args.eval_size
+    eh, ew = (eh // 32) * 32, (ew // 32) * 32
+    iters = 7
+    results = {k: [] for k in ["torch", *variants]}
+    for i in range(args.pairs):
+        i1, i2, d = make_pair(rng, eh, ew)
+        with torch.no_grad():
+            _, t_up = tmodel(
+                torch.from_numpy(i1.transpose(2, 0, 1))[None],
+                torch.from_numpy(i2.transpose(2, 0, 1))[None],
+                iters=iters, test_mode=True)
+        results["torch"].append(epe(-t_up.numpy()[0, 0], d))
+        for name, m in variants.items():
+            _, j_up = m.apply(converted, jnp.asarray(i1)[None],
+                              jnp.asarray(i2)[None],
+                              iters=iters, test_mode=True)
+            results[name].append(epe(-np.asarray(j_up)[0, ..., 0], d))
+        print(f"pair {i}: torch EPE {results['torch'][-1]:.4f}  " +
+              "  ".join(f"{k} {results[k][-1]:.4f}" for k in variants),
+              flush=True)
+
+    t_epe = float(np.mean(results["torch"]))
+    print(f"\nrealtime mean EPE over {args.pairs} pairs at {eh}x{ew}/"
+          f"{iters} iters:")
+    rel = {}
+    for k in variants:
+        j_epe = float(np.mean(results[k]))
+        rel[k] = abs(j_epe - t_epe) / max(t_epe, 1e-9)
+        print(f"  torch {t_epe:.4f} vs {k:26s} {j_epe:.4f}  "
+              f"rel-dev {100*rel[k]:.3f}%")
+    failed = [k for k in gated if rel[k] > args.tolerance]
+    if failed:
+        for k in failed:
+            print(f"FAIL: {k} relative EPE deviation {100*rel[k]:.3f}% "
+                  f"> {100*args.tolerance:.1f}%")
+        return 1
+    print(f"PASS: {', '.join(gated)} within {100*args.tolerance:.1f}% of "
+          f"the torch realtime baseline")
     return 0
 
 

@@ -1,0 +1,136 @@
+"""Probe the remote-compile helper's failure boundary at the flagship shape.
+
+The tunneled TPU's compile service has rejected every plain batch-8 train-step
+graph since round 1 (HTTP 500, helper subprocess exit 1) while smaller or
+remat-heavier graphs compile. This script compiles ISOLATED pieces of the
+step at batch 8 to locate the boundary:
+
+  1. encoders fwd+bwd only (full residuals, no remat),
+  2. refinement scan + loss + grads only (encoder outputs as graph INPUTS),
+  3. the full plain step (known-failing control).
+
+If 1 and 2 compile while 3 fails, a split-compilation train step (encoder
+piece + scan piece stitched through explicit residuals) can recover the
+plain-b8 schedule the monolithic graph is denied.
+
+Run: python scripts/probe_compile.py [--batch 8] [--pieces enc,scan,full]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.loss import loss_mask, sequence_loss_fused
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+
+def report(name, fn, *args):
+    t0 = time.time()
+    try:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        # fetch one scalar: tunneled devices can ack before execution ends
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jax.device_get(jax.tree.map(jnp.sum, leaf)))
+        print(f"[probe] {name}: OK in {time.time()-t0:.1f}s")
+        return True
+    except Exception as e:
+        print(f"[probe] {name}: FAIL in {time.time()-t0:.1f}s: "
+              f"{type(e).__name__}: {str(e)[:200]}")
+        return False
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--h", type=int, default=320)
+    p.add_argument("--w", type=int, default=720)
+    p.add_argument("--iters", type=int, default=22)
+    p.add_argument("--pieces", default="enc,scan,full")
+    args = p.parse_args()
+    pieces = args.pieces.split(",")
+
+    b, h, w = args.batch, args.h, args.w
+    cfg = RAFTStereoConfig(mixed_precision=True,
+                           corr_storage_dtype="bfloat16")
+    tcfg = TrainConfig(batch_size=b, train_iters=args.iters,
+                       num_steps=200000, image_size=(h, w))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+    tx = fetch_optimizer(tcfg)
+
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+    flow = jnp.asarray(rng.uniform(-64, 0, (b, h, w, 1)), jnp.float32)
+    valid = jnp.ones((b, h, w), jnp.float32)
+
+    if "enc" in pieces:
+        # encoders fwd+bwd as one graph, full residuals (the piece plain-b8
+        # saves that the remat fallbacks recompute)
+        from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
+
+        cnet = MultiBasicEncoder(output_dim=(cfg.hidden_dims, cfg.hidden_dims),
+                                 norm_fn=cfg.context_norm,
+                                 downsample=cfg.n_downsample,
+                                 dtype=jnp.bfloat16)
+        fnet = BasicEncoder(output_dim=256, norm_fn="instance",
+                            downsample=cfg.n_downsample, dtype=jnp.bfloat16)
+        kc = jax.random.PRNGKey(1)
+        cvars = cnet.init(kc, jnp.zeros((2, h, w, 3)), num_layers=3)
+        fvars = fnet.init(kc, jnp.zeros((2, h, w, 3)))
+
+        def enc_loss(cp, fp):
+            outs = cnet.apply(cp, jnp.concatenate([img1, img1], 0) / 255.0,
+                              num_layers=3)
+            fmaps = fnet.apply(fp, jnp.concatenate([img1, img2], 0) / 255.0)
+            s = sum(jnp.sum(jnp.abs(t.astype(jnp.float32)))
+                    for lvl in outs for t in lvl)
+            return s + jnp.sum(jnp.abs(fmaps.astype(jnp.float32)))
+
+        report("encoders fwd+bwd b%d" % b,
+               jax.jit(jax.grad(enc_loss, argnums=(0, 1))), cvars, fvars)
+
+    if "scan" in pieces:
+        # scan + loss + grads with the encoder outputs as INPUTS: the model
+        # applied to precomputed fmaps/context is approximated by gradding
+        # only the refinement/update params while encoders run under
+        # stop_gradient — the backward graph then contains no encoder bwd.
+        def scan_loss(refine_params, frozen_params):
+            params = {**frozen_params, **refine_params}
+            mask = loss_mask(flow, valid)
+            err_sums, final = model.apply(
+                {"params": params,
+                 "batch_stats": variables.get("batch_stats", {})},
+                img1, img2, iters=args.iters,
+                flow_gt=flow, loss_mask=mask)
+            return sequence_loss_fused(err_sums, final, flow, mask)[0]
+
+        refine = {k: v for k, v in variables["params"].items()
+                  if k in ("refinement",)}
+        frozen = jax.lax.stop_gradient(
+            {k: v for k, v in variables["params"].items()
+             if k not in ("refinement",)})
+        report("scan-only grads b%d" % b,
+               jax.jit(jax.grad(scan_loss)), refine, frozen)
+
+    if "full" in pieces:
+        state = TrainState.create(variables, tx)
+        step = jax.jit(make_train_step(model, tx, args.iters,
+                                       fused_loss=True))
+        batch = {"image1": img1, "image2": img2, "flow": flow, "valid": valid}
+        report("full plain step b%d (control)" % b, step, state, batch)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

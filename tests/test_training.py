@@ -174,6 +174,80 @@ def test_shardmap_dp_matches_single_device():
                                    rtol=2e-3, atol=2e-5)
 
 
+def test_pjit_stacked_step_runs():
+    """trainer.py's multi-chip combination — make_pjit_train_step with the
+    default stacked loss — must compile and execute on a dp x sp mesh (the
+    driver dryrun now runs the fused variant, so this is the stacked
+    path's only sharded execution)."""
+    from raft_stereo_tpu.parallel.mesh import (make_mesh, replicated,
+                                               shard_batch)
+    from raft_stereo_tpu.parallel.data_parallel import make_pjit_train_step
+
+    cfg = RAFTStereoConfig()
+    tcfg = TrainConfig(num_steps=10, batch_size=4, lr=1e-4)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+
+    rng = np.random.default_rng(3)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (4, 32, 48, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (4, 32, 48, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (4, 32, 48, 1)), jnp.float32),
+        "valid": jnp.ones((4, 32, 48), jnp.float32),
+    }
+    mesh = make_mesh(2, 2, devices=jax.devices()[:4])
+    with mesh:
+        st = jax.device_put(jax.tree.map(jnp.array, state), replicated(mesh))
+        placed = shard_batch(mesh, batch)
+        step = make_pjit_train_step(model, tx, 2, mesh, fused_loss=False)
+        new_state, metrics = step(st, placed)
+    assert int(new_state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_shardmap_fused_matches_single_device_fused():
+    """The fused-loss shard_map DP step must equal the single-device
+    fused-loss step (psum-global normalization of the in-scan error sums)."""
+    from raft_stereo_tpu.parallel.mesh import make_mesh, replicated
+    from raft_stereo_tpu.parallel.data_parallel import make_shardmap_train_step
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = RAFTStereoConfig()
+    tcfg = TrainConfig(num_steps=10, batch_size=4, lr=1e-4)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+
+    rng = np.random.default_rng(2)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (4, 32, 48, 3)), jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (4, 32, 48, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (4, 32, 48, 1)), jnp.float32),
+        "valid": jnp.ones((4, 32, 48), jnp.float32),
+    }
+
+    single = jax.jit(make_train_step(model, tx, train_iters=1,
+                                     fused_loss=True))
+    ref_state, ref_metrics = single(jax.tree.map(jnp.array, state), batch)
+
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])
+    with mesh:
+        st = jax.device_put(jax.tree.map(jnp.array, state), replicated(mesh))
+        sharded_batch = {k: jax.device_put(
+            v, NamedSharding(mesh, P("data"))) for k, v in batch.items()}
+        dp_step = make_shardmap_train_step(model, tx, 1, mesh,
+                                           fused_loss=True)
+        dp_state, dp_metrics = dp_step(st, sharded_batch)
+
+    assert float(dp_metrics["loss"]) == pytest.approx(
+        float(ref_metrics["loss"]), rel=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(dp_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
 @pytest.mark.parametrize("deferred", [True, False])
 def test_fused_loss_matches_stacked(deferred):
     """The fused loss paths (in-scan when deferred_upsample=False, post-scan
