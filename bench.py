@@ -30,7 +30,7 @@ BASELINE_PAIRS_PER_SEC_PER_CHIP = 20.0
 
 
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
-              remat_encoders=False):
+              remat_encoders=False, split_step=False):
     platform = jax.devices()[0].platform
     n_chips = jax.device_count()
 
@@ -64,6 +64,13 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
         batch_data = shard_batch(mesh, batch_data)
         step = make_pjit_train_step(model, tx, train_iters, mesh,
                                     fused_loss=fused_loss)
+    elif split_step:
+        # three-piece split compilation (training/split_step.py): the
+        # plain-b8 schedule — full encoder residuals, no encoder recompute —
+        # through graphs the degraded remote compile helper accepts
+        from raft_stereo_tpu.training.split_step import make_split_train_step
+        step = make_split_train_step(model, tx, train_iters,
+                                     fused_loss=fused_loss)
     else:
         step = jax.jit(make_train_step(model, tx, train_iters,
                                        fused_loss=fused_loss),
@@ -114,12 +121,27 @@ def main():
     # the JSON) rather than report nothing.
     if on_tpu:
         attempts = [
-            # Primary: deferred-upsample + fused loss — the fastest measured
-            # variant of the SceneFlow recipe (identical loss/metrics/updates
-            # to the stacked path, tests/test_training.py) AND the smallest
-            # graph/buffer footprint.
+            # Primary: the monolithic deferred-upsample + fused-loss step —
+            # the fastest variant IF the compile service accepts it (it has
+            # rejected every monolithic b8 graph since r1).
             dict(batch=8, h=320, w=720, train_iters=22, steps=6,
                  fused_loss=True),
+            # "norms" encoder remat: save conv outputs + norm stats,
+            # recompute only elementwise glue — no conv re-runs. Plain
+            # backward's residuals (24.9 GB at b8: fp32 norm intermediates +
+            # bool relu masks) cannot fit the 16 GB chip, which is the
+            # monolith failure's root cause; this policy keeps the MXU work
+            # saved at ~7 GB.
+            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
+                 fused_loss=True, remat_encoders="norms",
+                 _note="norms-remat (save convs, recompute glue), same recipe"),
+            # Split-compilation: the same step as three pieces the helper
+            # accepts (probe_compile.py) — plain-b8 schedule, full encoder
+            # residuals, no encoder recompute (OOMs at b8; viable for
+            # smaller shapes if the monolith is rejected).
+            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
+                 fused_loss=True, split_step=True,
+                 _note="split-compilation step, same recipe"),
             dict(batch=8, h=320, w=720, train_iters=22, steps=6,
                  _note="stacked-loss fallback, same recipe"),
             # The remote compile helper's failures are size-proportional:

@@ -75,8 +75,16 @@ class RAFTStereoConfig:
     # residuals at train shapes. True = recompute both whole encoders
     # (one extra encoder forward); "blocks" = remat each trunk residual
     # block individually (saves block inputs only — most of the memory win
-    # at a fraction of the recompute).
+    # at a fraction of the recompute); "norms" = save every conv output +
+    # norm statistics and recompute only the elementwise norm/relu glue
+    # (no conv re-runs — the fp32 norm intermediates and bool relu masks
+    # are what dominate plain-backward residual memory).
     remat_encoders: "bool | str" = False
+    # Under remat_encoders="norms": save conv outputs in a lane-dense folded
+    # shape (64/96-channel saves are otherwise padded 2x/1.33x to the
+    # 128-lane tile). None = auto by estimated padded size (folds at the
+    # SceneFlow b8 shape, not at b4); bool forces.
+    fold_enc_saves: Optional[bool] = None
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
@@ -88,10 +96,10 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
-        if self.remat_encoders not in (False, True, "blocks"):
+        if self.remat_encoders not in (False, True, "blocks", "norms"):
             raise ValueError(
-                f"remat_encoders must be False, True or 'blocks', got "
-                f"{self.remat_encoders!r}")
+                f"remat_encoders must be False, True, 'blocks' or 'norms', "
+                f"got {self.remat_encoders!r}")
         if self.corr_storage_dtype not in (None, "float32", "bfloat16"):
             raise ValueError(
                 f"unknown corr_storage_dtype {self.corr_storage_dtype!r}; "

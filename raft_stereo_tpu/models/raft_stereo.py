@@ -35,6 +35,11 @@ from raft_stereo_tpu.ops.geometry import (
 
 Dtype = Any
 
+# fp32 working-set budget for the post-scan batched upsample before it is
+# chunked over the iteration axis (module constant so tests can force the
+# chunked path at tiny shapes)
+_UPSAMPLE_TILE_BUDGET = 1024 * 1024 * 1024
+
 
 class RefinementStep(nn.Module):
     """One GRU refinement iteration — the body of the ``lax.scan``.
@@ -145,13 +150,36 @@ class RAFTStereo(nn.Module):
 
     @nn.compact
     def __call__(self, image1, image2, iters: int = 12, flow_init=None,
-                 test_mode: bool = False, flow_gt=None, loss_mask=None):
+                 test_mode: bool = False, flow_gt=None, loss_mask=None,
+                 stage: str = "full", enc_outs=None):
         """``flow_gt``/``loss_mask`` (both ``(B, H, W, 1)``) switch on the
         fused-loss training path: returns ``(per_iter_err_sums (iters,),
         final flow_up (B, H, W, 1))`` instead of the stacked predictions —
-        same math as sequence_loss over the stack, far less HBM traffic."""
+        same math as sequence_loss over the stack, far less HBM traffic.
+
+        ``stage`` supports split-compilation (training/split_step.py: the
+        remote compile helper rejects the monolithic flagship graph while
+        its pieces compile):
+
+        * ``"full"`` (default) — the whole forward, single graph.
+        * ``"encode"`` — run only the encoders; returns
+          ``(cnet_list, fmap1, fmap2)`` (the raw encoder outputs, before
+          the cheap tanh/relu/zqr processing, so the cross-piece cut
+          carries the fewest tensors).
+        * ``"refine"`` — everything after the encoders; ``enc_outs`` must
+          be the ``"encode"`` stage's output.
+
+        The staged path is the SAME traced computation — ``"full"`` is
+        exactly ``refine(encode(x))`` — so parameters, outputs, and
+        gradients are identical up to XLA scheduling.
+        """
         cfg = self.cfg
         dt = self.compute_dtype
+
+        if stage == "refine":
+            cnet_list, fmap1, fmap2 = enc_outs
+            return self._refine(cnet_list, fmap1, fmap2, iters, flow_init,
+                                test_mode, flow_gt, loss_mask)
 
         image1 = (2.0 * (image1 / 255.0) - 1.0).astype(jnp.float32)
         image2 = (2.0 * (image2 / 255.0) - 1.0).astype(jnp.float32)
@@ -177,12 +205,37 @@ class RAFTStereo(nn.Module):
             # it is not).
             _cnet_fwd = nn.remat(_cnet_fwd)
             _fnet_fwd = nn.remat(_fnet_fwd)
+        elif cfg.remat_encoders == "norms":
+            # Save every conv output (compute dtype) + the tiny norm stats;
+            # recompute the elementwise norm/relu/add glue in backward. The
+            # glue's saved form dominates plain-backward residual memory
+            # (24.9 GB at SceneFlow b8 — 14.1 GB fp32 norm intermediates,
+            # 3.6 GB bool relu masks — vs 7.1 GB of conv outputs), while its
+            # recompute is cheap bandwidth; unlike "blocks", no conv ever
+            # re-runs.
+            pol = jax.checkpoint_policies.save_only_these_names(
+                "enc_conv", "enc_stat")
+            _cnet_fwd = nn.remat(_cnet_fwd, policy=pol)
+            _fnet_fwd = nn.remat(_fnet_fwd, policy=pol)
         remat_blocks = cfg.remat_encoders == "blocks"
+
+        # Lane-dense folded saves under the "norms" policy: only when the
+        # padded saved-conv set wouldn't fit anyway. Calibration: 24 images
+        # of 320x720 (SceneFlow b8) measured 14.06 GB padded; the estimate
+        # is ~2.5 KB per image-pixel, folded above ~9 GB. Folding costs
+        # relayout copies (measured -65 ms/step at b4), so small shapes
+        # keep unfolded saves.
+        fold_saves = False
+        if cfg.remat_encoders == "norms":
+            n_images = image1.shape[0] * (2 if cfg.shared_backbone else 3)
+            est_padded = n_images * image1.shape[1] * image1.shape[2] * 2543
+            fold_saves = (cfg.fold_enc_saves if cfg.fold_enc_saves is not None
+                          else est_padded > 9_000_000_000)
 
         cnet = MultiBasicEncoder(
             output_dim=(cfg.hidden_dims, cfg.hidden_dims),
             norm_fn=cfg.context_norm, downsample=cfg.n_downsample, dtype=dt,
-            remat_blocks=remat_blocks, name="cnet")
+            remat_blocks=remat_blocks, fold_saves=fold_saves, name="cnet")
         if cfg.shared_backbone:
             *cnet_list, trunk = _cnet_fwd(
                 cnet, jnp.concatenate([image1, image2], axis=0))
@@ -194,10 +247,24 @@ class RAFTStereo(nn.Module):
             cnet_list = _cnet_fwd(cnet, image1)
             fnet = BasicEncoder(output_dim=256, norm_fn="instance",
                                 downsample=cfg.n_downsample, dtype=dt,
-                                remat_blocks=remat_blocks, name="fnet")
+                                remat_blocks=remat_blocks,
+                                fold_saves=fold_saves, name="fnet")
             fmaps = _fnet_fwd(fnet,
                               jnp.concatenate([image1, image2], axis=0))
             fmap1, fmap2 = jnp.split(fmaps, 2, axis=0)
+
+        if stage == "encode":
+            return tuple(cnet_list), fmap1, fmap2
+        return self._refine(tuple(cnet_list), fmap1, fmap2, iters, flow_init,
+                            test_mode, flow_gt, loss_mask)
+
+    def _refine(self, cnet_list, fmap1, fmap2, iters, flow_init, test_mode,
+                flow_gt, loss_mask):
+        """Post-encoder forward: context processing, correlation pyramid, the
+        refinement scan, and the upsample/loss tail. Called from the compact
+        ``__call__`` (both the monolithic and staged paths)."""
+        cfg = self.cfg
+        dt = self.compute_dtype
 
         net_list = [jnp.tanh(x[0]) for x in cnet_list]
         inp_list = [nn.relu(x[1]) for x in cnet_list]
@@ -336,10 +403,6 @@ class RAFTStereo(nn.Module):
         if deferred:
             lowres, masks = flow_predictions  # (it,B,h,w,1), (it,B,h,w,9f^2)
             it, bb, hp, wp = lowres.shape[:4]
-            tiles = convex_upsample_tiles(
-                lowres.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
-                masks.reshape(it * bb, hp, wp, -1).astype(jnp.float32),
-                cfg.factor)  # (it*B, h, w, f, f)
             if fused:
                 # loss in tile layout: |pred - gt| summed over pixels is
                 # layout-invariant, so transpose the (B,H,W) GT/mask ONCE
@@ -349,13 +412,55 @@ class RAFTStereo(nn.Module):
                     flow_gt.astype(jnp.float32), cfg.factor)
                 mask_t = image_to_upsample_tiles(
                     loss_mask.astype(jnp.float32), cfg.factor)
-                err = jnp.abs(tiles.reshape(it, bb, hp, wp,
-                                            cfg.factor, cfg.factor)
-                              - gt_t[None])
-                err = jnp.where(mask_t[None] > 0, err, 0.0)
-                err_sums = jnp.sum(err, axis=(1, 2, 3, 4, 5))
-                final_up = upsample_tiles_to_image(tiles[(it - 1) * bb:])
+
+                # Chunk the iteration axis: the one-shot batched upsample's
+                # (it*B, h, w, f, f) fp32 intermediates are the train step's
+                # largest HLO temps (1.9 GB at the SceneFlow b8 shape) right
+                # when residual pressure peaks. Upsample+reduce per chunk
+                # bounds the temp at ~chunk/it of that while keeping the
+                # batching win over in-scan upsampling; shapes whose full
+                # temp already fits stay one-shot (chunking is lax.map
+                # serialization — pure cost when memory is plentiful).
+                budget = _UPSAMPLE_TILE_BUDGET
+                tile_bytes = bb * hp * wp * (9 + 2) * cfg.factor ** 2 * 4
+                nch = 1
+                if it * tile_bytes > budget:
+                    for cand in range(2, it + 1):
+                        if it % cand:
+                            continue
+                        if (it // cand) * tile_bytes <= budget:
+                            nch = cand
+                            break
+
+                def chunk_err(args):
+                    lr_c, mk_c = args  # (itc, B, h, w, ...)
+                    itc = lr_c.shape[0]
+                    t = convex_upsample_tiles(
+                        lr_c.reshape(itc * bb, hp, wp, 1).astype(jnp.float32),
+                        mk_c.reshape(itc * bb, hp, wp, -1).astype(jnp.float32),
+                        cfg.factor)
+                    e = jnp.abs(t.reshape(itc, bb, hp, wp, cfg.factor,
+                                          cfg.factor) - gt_t[None])
+                    e = jnp.where(mask_t[None] > 0, e, 0.0)
+                    return jnp.sum(e, axis=(1, 2, 3, 4, 5))
+
+                if nch > 1:
+                    itc = it // nch
+                    err_sums = jax.lax.map(chunk_err, (
+                        lowres.reshape(nch, itc, bb, hp, wp, -1),
+                        masks.reshape(nch, itc, bb, hp, wp, -1),
+                    )).reshape(it)
+                else:
+                    err_sums = chunk_err((lowres, masks))
+                final_tiles = convex_upsample_tiles(
+                    lowres[-1].astype(jnp.float32),
+                    masks[-1].astype(jnp.float32), cfg.factor)
+                final_up = upsample_tiles_to_image(final_tiles)
                 return err_sums, final_up
+            tiles = convex_upsample_tiles(
+                lowres.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
+                masks.reshape(it * bb, hp, wp, -1).astype(jnp.float32),
+                cfg.factor)  # (it*B, h, w, f, f)
             up = upsample_tiles_to_image(tiles)
             return up.reshape(it, bb, hp * cfg.factor, wp * cfg.factor, 1)
         if fused:

@@ -14,7 +14,9 @@ from typing import Any, Optional, Sequence, Tuple
 import jax.numpy as jnp
 from flax import linen as nn
 
-from raft_stereo_tpu.nn.layers import Conv, ResidualBlock, apply_norm, make_norm
+
+from raft_stereo_tpu.nn.layers import (Conv, ResidualBlock, apply_norm,
+                                       make_norm, save_conv_output)
 
 Dtype = Any
 
@@ -37,22 +39,25 @@ class _Trunk(nn.Module):
     downsample: int
     dtype: Optional[Dtype] = None
     remat_blocks: bool = False
+    fold_saves: bool = False
 
     @nn.compact
     def __call__(self, x):
         d = self.dtype
+        fs = self.fold_saves
         RB = nn.remat(ResidualBlock) if self.remat_blocks else ResidualBlock
-        x = Conv.make(64, 7, 1 + (self.downsample > 2), 3, d, "conv1")(x)
+        x = save_conv_output(
+            Conv.make(64, 7, 1 + (self.downsample > 2), 3, d, "conv1")(x), fs)
         x = apply_norm(make_norm(self.norm_fn, 64, num_groups=8, name="norm1"), x)
         x = nn.relu(x)
-        x = RB(64, 64, self.norm_fn, 1, d, name="layer1_0")(x)
-        x = RB(64, 64, self.norm_fn, 1, d, name="layer1_1")(x)
-        x = RB(64, 96, self.norm_fn, 1 + (self.downsample > 1), d,
+        x = RB(64, 64, self.norm_fn, 1, d, fs, name="layer1_0")(x)
+        x = RB(64, 64, self.norm_fn, 1, d, fs, name="layer1_1")(x)
+        x = RB(64, 96, self.norm_fn, 1 + (self.downsample > 1), d, fs,
                name="layer2_0")(x)
-        x = RB(96, 96, self.norm_fn, 1, d, name="layer2_1")(x)
-        x = RB(96, 128, self.norm_fn, 1 + (self.downsample > 0), d,
+        x = RB(96, 96, self.norm_fn, 1, d, fs, name="layer2_1")(x)
+        x = RB(96, 128, self.norm_fn, 1 + (self.downsample > 0), d, fs,
                name="layer3_0")(x)
-        x = RB(128, 128, self.norm_fn, 1, d, name="layer3_1")(x)
+        x = RB(128, 128, self.norm_fn, 1, d, fs, name="layer3_1")(x)
         return x
 
 
@@ -70,14 +75,17 @@ class BasicEncoder(nn.Module):
     dropout: float = 0.0
     dtype: Optional[Dtype] = None
     remat_blocks: bool = False
+    fold_saves: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         d = self.dtype
         x = _Trunk(self.norm_fn, self.downsample, d, self.remat_blocks,
-                   name="trunk")(x)
+                   self.fold_saves, name="trunk")(x)
 
-        x = Conv.make(self.output_dim, 1, 1, 0, d, "conv2")(x)
+        x = save_conv_output(
+            Conv.make(self.output_dim, 1, 1, 0, d, "conv2")(x),
+            self.fold_saves)
         if train and self.dropout > 0:
             x = nn.Dropout(rate=self.dropout, deterministic=False)(x)
         return x
@@ -108,13 +116,14 @@ class MultiBasicEncoder(nn.Module):
     dropout: float = 0.0
     dtype: Optional[Dtype] = None
     remat_blocks: bool = False
+    fold_saves: bool = False
 
     @nn.compact
     def __call__(self, x, *, dual_inp: bool = False, num_layers: int = 3,
                  train: bool = False):
         d = self.dtype
         x = _Trunk(self.norm_fn, self.downsample, d, self.remat_blocks,
-                   name="trunk")(x)
+                   self.fold_saves, name="trunk")(x)
 
         if dual_inp:
             trunk = x

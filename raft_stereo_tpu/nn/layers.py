@@ -16,8 +16,53 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 Dtype = Any
+
+# Residual-policy tags consumed by ``remat_encoders="norms"``
+# (models/raft_stereo.py): under
+# ``save_only_these_names("enc_conv", "enc_stat")`` the encoder backward
+# keeps every conv output (compute-dtype, the MXU work) plus the tiny norm
+# statistics, and recomputes the elementwise norm/relu/add glue — whose
+# saved form otherwise dominates residual memory (measured at the SceneFlow
+# batch-8 shape: 24.9 GB total, of which 14.1 GB fp32 norm intermediates and
+# 3.6 GB bool relu masks vs 7.1 GB of conv outputs). Inert outside a remat
+# policy.
+ENC_CONV_TAG = "enc_conv"
+ENC_STAT_TAG = "enc_stat"
+
+
+def save_conv_output(x, fold: bool = False):
+    """Tag a conv output for the "norms" remat policy; optionally lane-dense.
+
+    TPU layouts put the channel dim on 128 lanes; a 64- or 96-channel
+    activation saved as-is is padded 2x / 1.33x in HBM — measured at the
+    SceneFlow batch-8 shape, that padding (8.8 GB unpadded -> 14.1 GB
+    padded) is what pushes the saved-conv residual set out of a 16 GB chip.
+    With ``fold=True``, W is folded into the channel dim up to a 128
+    multiple before tagging, so the SAVED form is exactly lane-sized; the
+    immediate unfold means the surrounding computation is unchanged
+    (reshape-of-reshape cancels to identity whenever no remat policy
+    consumes the tag, and is a linear-order-preserving bitcast of the
+    unpadded data when one does). Folding costs relayout copies both ways
+    (measured −65 ms/step at batch 4, where memory is plentiful), so the
+    model enables it only when the padded saves wouldn't fit
+    (models/raft_stereo.py auto rule).
+    """
+    if not fold or x.ndim != 4:
+        return checkpoint_name(x, ENC_CONV_TAG)
+    b, h, w, c = x.shape
+    factor = 1
+    for f in (1, 2, 4, 8):
+        if (c * f) % 128 == 0 and w % f == 0:
+            factor = f
+            break
+    if factor == 1:
+        return checkpoint_name(x, ENC_CONV_TAG)
+    folded = checkpoint_name(x.reshape(b, h, w // factor, factor * c),
+                             ENC_CONV_TAG)
+    return folded.reshape(b, h, w, c)
 
 # torch norm-layer epsilon (BatchNorm2d/InstanceNorm2d/GroupNorm all 1e-5)
 NORM_EPS = 1e-5
@@ -99,8 +144,9 @@ class InstanceNorm(nn.Module):
         y = x32 - shift
         s1 = jnp.sum(y, axis=(1, 2), keepdims=True)
         s2 = jnp.sum(y * y, axis=(1, 2), keepdims=True)
-        mean_y = s1 / n
-        var = jnp.maximum(s2 / n - mean_y * mean_y, 0.0)
+        mean_y = checkpoint_name(s1 / n, ENC_STAT_TAG)
+        var = checkpoint_name(
+            jnp.maximum(s2 / n - mean_y * mean_y, 0.0), ENC_STAT_TAG)
         out = (y - mean_y) * jax.lax.rsqrt(var + NORM_EPS)
         return out.astype(x.dtype)
 
@@ -129,8 +175,9 @@ class GroupNorm(nn.Module):
         y = g - g[:, :1, :1, :, :1]
         s1 = jnp.sum(y, axis=(1, 2, 4), keepdims=True)
         s2 = jnp.sum(y * y, axis=(1, 2, 4), keepdims=True)
-        mean_y = s1 / n
-        var = jnp.maximum(s2 / n - mean_y * mean_y, 0.0)
+        mean_y = checkpoint_name(s1 / n, ENC_STAT_TAG)
+        var = checkpoint_name(
+            jnp.maximum(s2 / n - mean_y * mean_y, 0.0), ENC_STAT_TAG)
         out = ((y - mean_y) * jax.lax.rsqrt(var + NORM_EPS)).reshape(b, h, w, c)
         return (out * scale + bias).astype(x.dtype)
 
@@ -169,19 +216,25 @@ class ResidualBlock(nn.Module):
     norm_fn: str = "group"
     stride: int = 1
     dtype: Optional[Dtype] = None
+    fold_saves: bool = False
 
     @nn.compact
     def __call__(self, x):
-        y = Conv.make(self.planes, 3, self.stride, 1, self.dtype, "conv1")(x)
+        y = save_conv_output(
+            Conv.make(self.planes, 3, self.stride, 1, self.dtype, "conv1")(x),
+            self.fold_saves)
         y = apply_norm(make_norm(self.norm_fn, self.planes, name="norm1"), y)
         y = nn.relu(y)
-        y = Conv.make(self.planes, 3, 1, 1, self.dtype, "conv2")(y)
+        y = save_conv_output(
+            Conv.make(self.planes, 3, 1, 1, self.dtype, "conv2")(y),
+            self.fold_saves)
         y = apply_norm(make_norm(self.norm_fn, self.planes, name="norm2"), y)
         y = nn.relu(y)
 
         if not (self.stride == 1 and self.in_planes == self.planes):
-            x = Conv.make(self.planes, 1, self.stride, 0, self.dtype,
-                          "down_conv")(x)
+            x = save_conv_output(
+                Conv.make(self.planes, 1, self.stride, 0, self.dtype,
+                          "down_conv")(x), self.fold_saves)
             x = apply_norm(make_norm(self.norm_fn, self.planes, name="norm3"), x)
         return nn.relu(x + y)
 

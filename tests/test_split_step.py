@@ -1,0 +1,101 @@
+"""Split-compilation step (training/split_step.py) vs the monolithic step.
+
+The split step is the same computation scheduled as separate XLA programs;
+differences are fp32 reassociation noise (jit-vs-eager-scale), so the gates
+mirror the shard_map equivalence tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.split_step import (_split_params,
+                                                 make_split_train_step)
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+
+def _setup(cfg_kwargs=None, batch_size=2, h=32, w=48):
+    cfg = RAFTStereoConfig(**(cfg_kwargs or {}))
+    tcfg = TrainConfig(num_steps=10, batch_size=batch_size, lr=1e-4)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+    rng = np.random.default_rng(7)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (batch_size, h, w, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (batch_size, h, w, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (batch_size, h, w, 1)),
+                            jnp.float32),
+        "valid": jnp.ones((batch_size, h, w), jnp.float32),
+    }
+    return model, tx, state, batch
+
+
+def _fresh(state):
+    return jax.tree.map(lambda x: jnp.array(x), state)
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_split_step_matches_monolithic(fused):
+    model, tx, state, batch = _setup()
+    mono = jax.jit(make_train_step(model, tx, train_iters=2,
+                                   fused_loss=fused))
+    ref_state, ref_metrics = mono(_fresh(state), batch)
+
+    split = make_split_train_step(model, tx, train_iters=2, fused_loss=fused)
+    got_state, got_metrics = split(_fresh(state), batch)
+
+    assert float(got_metrics["loss"]) == pytest.approx(
+        float(ref_metrics["loss"]), rel=1e-4)
+    for k in ref_metrics:
+        assert float(got_metrics[k]) == pytest.approx(
+            float(ref_metrics[k]), rel=1e-3, abs=1e-5), k
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(got_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+    assert int(got_state.step) == 1
+
+
+def test_split_step_multiple_steps_and_shared_backbone():
+    """Two consecutive split steps (state threading, cache reuse) on the
+    shared-backbone arch (the conv2_res/conv2_out encoder keys)."""
+    model, tx, state, batch = _setup(
+        dict(shared_backbone=True, n_downsample=3, n_gru_layers=2))
+    mono = jax.jit(make_train_step(model, tx, train_iters=2, fused_loss=True))
+    s_ref, _ = mono(_fresh(state), batch)
+    s_ref, m_ref = mono(s_ref, batch)
+
+    split = make_split_train_step(model, tx, train_iters=2, fused_loss=True)
+    s_got, _ = split(_fresh(state), batch)
+    s_got, m_got = split(s_got, batch)
+
+    assert int(s_got.step) == 2
+    assert float(m_got["loss"]) == pytest.approx(float(m_ref["loss"]),
+                                                 rel=1e-3)
+    # AdamW's early steps are ~±lr·sign(grad) (v ≈ 0), so fp32 reassociation
+    # noise on near-zero grads can flip an element's update sign; bound the
+    # deviation by a few lr (1e-4) rather than a tight relative gate.
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
+                    jax.tree_util.tree_leaves(s_got.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-2, atol=5e-4)
+
+
+def test_split_params_partition():
+    """Every top-level param key lands in exactly one piece, for both archs."""
+    for kwargs in ({}, dict(shared_backbone=True, n_downsample=3,
+                            n_gru_layers=2)):
+        cfg = RAFTStereoConfig(**kwargs)
+        _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+        enc, rest = _split_params(variables["params"])
+        assert set(enc) | set(rest) == set(variables["params"])
+        assert not (set(enc) & set(rest))
+        assert "cnet" in enc
+        assert "refinement" in rest

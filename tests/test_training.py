@@ -282,6 +282,35 @@ def test_fused_loss_matches_stacked(deferred):
                                    rtol=1e-5, err_msg=k)
 
 
+def test_chunked_deferred_upsample_matches():
+    """Forcing the chunked post-scan upsample (tiny tile budget) must not
+    change the fused loss/metrics."""
+    from raft_stereo_tpu.models import raft_stereo as rs_mod
+    from raft_stereo_tpu.training.loss import loss_mask, sequence_loss_fused
+
+    cfg = RAFTStereoConfig()
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 32, 48, 3))
+    rng = np.random.default_rng(5)
+    img1 = jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (2, 32, 48, 3)), jnp.float32)
+    gt = jnp.asarray(rng.uniform(-8, 0, (2, 32, 48, 1)), jnp.float32)
+    valid = jnp.ones((2, 32, 48), jnp.float32)
+    mask = loss_mask(gt, valid)
+
+    err_a, up_a = model.apply(variables, img1, img2, iters=4, flow_gt=gt,
+                              loss_mask=mask)
+    budget0 = rs_mod._UPSAMPLE_TILE_BUDGET
+    rs_mod._UPSAMPLE_TILE_BUDGET = 1  # force maximal chunking
+    try:
+        err_b, up_b = model.apply(variables, img1, img2, iters=4, flow_gt=gt,
+                                  loss_mask=mask)
+    finally:
+        rs_mod._UPSAMPLE_TILE_BUDGET = budget0
+    np.testing.assert_allclose(np.asarray(err_a), np.asarray(err_b),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(up_a), np.asarray(up_b), atol=1e-6)
+
+
 def test_encoder_remat_variants_identical():
     """remat_encoders in {False, True, 'blocks'} is pure scheduling: forward
     outputs and parameter gradients must be identical."""
@@ -305,8 +334,13 @@ def test_encoder_remat_variants_identical():
 
     want_out = model0.apply(variables, img1, img2, iters=2)
     want_g = jax.grad(loss(model0))(variables["params"])
-    for variant in (True, "blocks"):
-        m = create_model(RAFTStereoConfig(remat_encoders=variant))
+    for variant in (True, "blocks", "norms"):
+        kwargs = {"remat_encoders": variant}
+        if variant == "norms":
+            # also exercise the lane-dense folded saves (auto rule keeps
+            # them off at test shapes)
+            kwargs["fold_enc_saves"] = True
+        m = create_model(RAFTStereoConfig(**kwargs))
         got_out = m.apply(variables, img1, img2, iters=2)
         np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
                                    atol=1e-6, err_msg=str(variant))
