@@ -11,32 +11,55 @@ Baseline: the driver's north-star target of 20 stereo-pairs/sec/chip
 (BASELINE.json). On non-TPU hosts a reduced shape is used so the benchmark
 stays runnable; the JSON notes the platform so numbers are not comparable
 across platforms.
+
+Harness design (r4): every attempt runs in a FRESH SUBPROCESS. Round 3 lost
+its number to cumulative in-process leakage — two remote-compile HTTP 500s
+pinned their attempts' buffers (state + batch + compiled pieces, retained via
+the exception traceback) and every later attempt, down to batch 2, died
+RESOURCE_EXHAUSTED on a 16 GB chip that had run batch 8 the round before.
+Subprocess isolation guarantees each attempt starts with empty HBM and
+survives a wedged compile helper (per-attempt timeout). The chain is ordered
+primary -> proven banker -> fallbacks; the banker (b8 + encoder-block remat,
+9.32 pairs/s in r2) banks a number before anything risky, and the parent
+emits the BEST successful JSON even if other attempts fail.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
-from raft_stereo_tpu.models import init_model
-from raft_stereo_tpu.training.optim import fetch_optimizer
-from raft_stereo_tpu.training.state import TrainState, make_train_step
-
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 20.0
+_RESULT_MARK = "BENCH_RESULT_JSON:"
+
+# Per-attempt wall-clock cap: compile (remote helper, observed 1-4 min on the
+# big graphs) + 8 steps + import overhead. A wedged helper burns one slot,
+# not the round.
+_ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1500"))
+# Overall budget: once exceeded, remaining attempts are skipped and the best
+# banked result (if any) is emitted.
+_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "3000"))
 
 
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
-              remat_encoders=False, split_step=False):
+              remat_encoders=False, split_step=False, fused_lookup=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState, make_train_step
+
     platform = jax.devices()[0].platform
     n_chips = jax.device_count()
 
     cfg = RAFTStereoConfig(mixed_precision=True,
                            corr_storage_dtype="bfloat16",
-                           remat_encoders=remat_encoders)
+                           remat_encoders=remat_encoders,
+                           fused_lookup=fused_lookup)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 
@@ -65,9 +88,8 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
         step = make_pjit_train_step(model, tx, train_iters, mesh,
                                     fused_loss=fused_loss)
     elif split_step:
-        # three-piece split compilation (training/split_step.py): the
-        # plain-b8 schedule — full encoder residuals, no encoder recompute —
-        # through graphs the degraded remote compile helper accepts
+        # three-piece split compilation (training/split_step.py) for graphs
+        # the degraded remote compile helper rejects monolithically
         from raft_stereo_tpu.training.split_step import make_split_train_step
         step = make_split_train_step(model, tx, train_iters,
                                      fused_loss=fused_loss)
@@ -112,75 +134,163 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
     }
 
 
-def main():
-    on_tpu = jax.devices()[0].platform == "tpu"
+# r2's proven blocks-remat number: attempts marked "below_par" keep running
+# until the banked best reaches it, so regressions in newer paths can't
+# silently cap the round.
+_PAR_PAIRS_PER_SEC = 9.3
 
-    # SceneFlow recipe (README.md:130); reduced shapes keep CPU smoke runs
-    # fast. The tunneled TPU compile service has been observed to 500 on the
-    # largest graphs when degraded — fall back to reduced recipes (flagged in
-    # the JSON) rather than report nothing.
-    if on_tpu:
-        attempts = [
-            # Primary: the monolithic deferred-upsample + fused-loss step —
-            # the fastest variant IF the compile service accepts it (it has
-            # rejected every monolithic b8 graph since r1).
-            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True),
-            # "norms" encoder remat: save conv outputs + norm stats,
-            # recompute only elementwise glue — no conv re-runs. Plain
-            # backward's residuals (24.9 GB at b8: fp32 norm intermediates +
-            # bool relu masks) cannot fit the 16 GB chip, which is the
-            # monolith failure's root cause; this policy keeps the MXU work
-            # saved at ~7 GB.
-            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True, remat_encoders="norms",
-                 _note="norms-remat (save convs, recompute glue), same recipe"),
-            # Split-compilation: the same step as three pieces the helper
-            # accepts (probe_compile.py) — plain-b8 schedule, full encoder
-            # residuals, no encoder recompute (OOMs at b8; viable for
-            # smaller shapes if the monolith is rejected).
-            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True, split_step=True,
-                 _note="split-compilation step, same recipe"),
-            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
-                 _note="stacked-loss fallback, same recipe"),
-            # The remote compile helper's failures are size-proportional:
-            # when the full batch-8 graph is rejected, walk down through
-            # smaller-footprint variants of the same recipe before shrinking
-            # the batch (throughput rises with batch, t(B) = fixed + k*B).
-            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True, remat_encoders="blocks",
-                 _note="encoder-block-remat fallback, same recipe"),
-            dict(batch=8, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True, remat_encoders=True,
-                 _note="encoder-remat fallback, same recipe"),
-            dict(batch=6, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True, _note="reduced batch (6) fallback"),
-            dict(batch=4, h=320, w=720, train_iters=22, steps=6,
-                 fused_loss=True, _note="reduced batch fallback"),
-            dict(batch=2, h=224, w=480, train_iters=22, steps=6,
-                 fused_loss=True, _note="reduced recipe fallback"),
-        ]
-    else:
-        attempts = [dict(batch=2, h=96, w=160, train_iters=4, steps=3)]
 
-    last_err = None
-    for kw in attempts:
-        kw = dict(kw)
-        note = kw.pop("_note", None)
+def _attempt_chain(on_tpu):
+    """Ordered attempt list. ``when`` controls skipping:
+
+    * ``always`` — run regardless of banked results (could beat them),
+    * ``below_par`` — run unless the banked best already meets
+      ``_PAR_PAIRS_PER_SEC``,
+    * ``unbanked`` — run only while no result is banked yet (fallbacks).
+    """
+    if not on_tpu:
+        return [dict(kw=dict(batch=2, h=96, w=160, train_iters=4, steps=3),
+                     when="always", note=None)]
+    recipe = dict(h=320, w=720, train_iters=22, steps=6)
+    return [
+        # Primary: monolithic deferred-upsample + fused-loss b8 — the fastest
+        # variant IF the compile service accepts it (it has rejected every
+        # monolithic b8 graph since r1, but a healthy helper should take it).
+        dict(kw=dict(batch=8, fused_loss=True, **recipe),
+             when="always", note=None),
+        # BANKER: r2's proven number (9.32 pairs/s) — block-granular encoder
+        # remat shrinks the graph below the degraded helper's threshold.
+        # Runs immediately after the primary so a number is banked before
+        # anything experimental.
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
+                     **recipe),
+             when="unbanked", note="encoder-block-remat banker, same recipe"),
+        # The exact r2-measured banker (fused_lookup pinned OFF): insurance
+        # against a fused-lookup kernel PERFORMANCE regression, not just a
+        # hard failure — it runs whenever the banked best is still below
+        # par (r2's 9.3), so a kernel that works but got slower cannot
+        # silently cap the round's number.
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
+                     fused_lookup=False, **recipe),
+             when="below_par", note="blocks-remat banker, unfused lookup"),
+        # Experiment: split-compilation composed with the "norms" encoder
+        # residual policy — piece_enc emits ~7 GB of conv-output residuals
+        # instead of the 24.9 GB full set that OOM'd the r3 split attempt,
+        # and piece_bwd recomputes only elementwise glue (no conv re-runs —
+        # the schedule the rejected monolith would run). Could beat the
+        # banker, so it runs even once a number is banked.
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="norms",
+                     split_step=True, **recipe),
+             when="always", note="split-step + norms-remat experiment"),
+        # Fallbacks, expected slower than the banker — only run while
+        # nothing is banked.
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="norms",
+                     **recipe),
+             when="unbanked", note="norms-remat fallback, same recipe"),
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders=True, **recipe),
+             when="unbanked", note="encoder-remat fallback, same recipe"),
+        dict(kw=dict(batch=4, fused_loss=True, **recipe),
+             when="unbanked", note="reduced batch fallback"),
+        dict(kw=dict(batch=2, h=224, w=480, train_iters=22, steps=6,
+                     fused_loss=True),
+             when="unbanked", note="reduced recipe fallback"),
+    ]
+
+
+def _run_attempt_subprocess(kw):
+    """Run one attempt in a fresh interpreter; return its result dict or None."""
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--attempt", json.dumps(kw)]
+    try:
+        proc = subprocess.run(
+            cmd, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=_ATTEMPT_TIMEOUT_S, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"bench attempt {kw} timed out after {_ATTEMPT_TIMEOUT_S}s",
+              file=sys.stderr)
+        return None
+    out = proc.stdout or ""
+    for line in out.splitlines():
+        if line.startswith(_RESULT_MARK):
+            try:
+                return json.loads(line[len(_RESULT_MARK):])
+            except json.JSONDecodeError:
+                break
+    # surface the actual error line, not the traceback boilerplate
+    lines = out.splitlines()
+    err_lines = [l for l in lines if "Error" in l or "RESOURCE" in l
+                 or "INTERNAL" in l][-3:]
+    tail = "\n".join(err_lines or lines[-8:])
+    print(f"bench attempt {kw} failed (rc={proc.returncode}):\n{tail}",
+          file=sys.stderr)
+    return None
+
+
+def _probe_on_tpu():
+    """Platform probe in a child process, crash-proof: a wedged TPU-plugin
+    import (the degraded environment this harness exists for) must not take
+    the parent down. Inconclusive probes assume TPU — this is the driver's
+    TPU benchmark, every attempt is subprocess-isolated and time-bounded,
+    and a wrong-shape CPU number would be worse than a late failure."""
+    for t in (300, 120):
         try:
-            result = run_bench(**kw)
-        except Exception as e:  # remote-compile failure / OOM
-            last_err = e
-            print(f"bench attempt {kw} failed: {type(e).__name__}: "
-                  f"{str(e)[:160]}", file=sys.stderr)
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+                timeout=t)
+        except Exception as e:
+            print(f"platform probe failed: {e!r}; retrying", file=sys.stderr)
             continue
-        if note:
-            result["note"] = note
-        print(json.dumps(result))
+        lines = probe.stdout.strip().splitlines()
+        if probe.returncode == 0 and lines:
+            return lines[-1] == "tpu"
+        print(f"platform probe rc={probe.returncode}; retrying",
+              file=sys.stderr)
+    print("platform probe inconclusive; assuming TPU", file=sys.stderr)
+    return True
+
+
+def main():
+    if "--attempt" in sys.argv:
+        # Child mode: one attempt, fresh HBM, result on a marked line.
+        kw = json.loads(sys.argv[sys.argv.index("--attempt") + 1])
+        result = run_bench(**kw)
+        print(_RESULT_MARK + json.dumps(result), flush=True)
         return 0
-    print(f"all bench attempts failed: {last_err}", file=sys.stderr)
-    return 1
+
+    # Parent mode: probe the platform cheaply (no jax import in the parent —
+    # keep the parent immune to anything an attempt can break). The probe's
+    # own wall clock counts against the deadline.
+    t_start = time.monotonic()
+    on_tpu = _probe_on_tpu()
+
+    best = None
+    for att in _attempt_chain(on_tpu):
+        if att["when"] == "unbanked" and best is not None:
+            continue
+        if (att["when"] == "below_par" and best is not None
+                and best["value"] >= _PAR_PAIRS_PER_SEC):
+            continue
+        if time.monotonic() - t_start > _DEADLINE_S:
+            print("bench deadline reached; stopping the chain",
+                  file=sys.stderr)
+            break
+        result = _run_attempt_subprocess(att["kw"])
+        if result is None:
+            continue
+        if att["note"]:
+            result["note"] = att["note"]
+        print(f"bench attempt ok: {result}", file=sys.stderr)
+        if best is None or result["value"] > best["value"]:
+            best = result
+
+    if best is None:
+        print("all bench attempts failed", file=sys.stderr)
+        return 1
+    print(json.dumps(best))
+    return 0
 
 
 if __name__ == "__main__":

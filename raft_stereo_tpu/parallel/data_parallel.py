@@ -74,14 +74,15 @@ def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
     """
     import dataclasses
 
-    if getattr(model.cfg, "fused_motion", None):
-        # The fused lookup+motion Pallas kernel has no SPMD partitioning
+    if getattr(model.cfg, "fused_lookup", False) is not False:
+        # The fused lookup+convc1 Pallas kernel has no SPMD partitioning
         # rule: under auto-SPMD it would force its operands replicated
         # (gathering the full volume onto every device). The explicit
         # shard_map DP path sees per-shard shapes and keeps the kernel;
-        # this path falls back to the unfused (identical-semantics) graph.
+        # this path forces the unfused (identical-semantics) graph — also
+        # overriding the auto(None)-resolves-ON TPU default.
         model = model.clone(
-            cfg=dataclasses.replace(model.cfg, fused_motion=False))
+            cfg=dataclasses.replace(model.cfg, fused_lookup=False))
     step = make_train_step(model, tx, train_iters, axis_name=None,
                            fused_loss=fused_loss)
     state_sharding = replicated(mesh)

@@ -24,7 +24,16 @@ pieces into one training step:
 The math is the monolithic step's: ``stage="full"`` is literally
 ``refine(encode(x))`` (models/raft_stereo.py), the vjp jaxpr is the same
 backward XLA would run in-graph, and the pieces differ only in scheduling —
-equivalence is tested in tests/test_split_step.py. Gradients w.r.t. the
+equivalence is tested in tests/test_split_step.py.
+
+The split composes with the ``remat_encoders`` residual policies: the
+policy's ``nn.remat`` wrapper lives inside the encode stage, so the traced
+vjp saves (= piece_enc's residual outputs) are whatever the policy keeps.
+With the default (no remat) the full residual set is ~24.9 GB at SceneFlow
+batch 8 — runtime-OOM on a 16 GB chip even though the pieces compile (the
+r3 failure); with ``remat_encoders="norms"`` piece_enc emits only conv
+outputs + norm stats (~7 GB) and piece_bwd recomputes the elementwise glue,
+which is the schedule to use at batch 8. Gradients w.r.t. the
 input images are not computed (the monolithic step doesn't either), and the
 per-shape caches mean the first call compiles three graphs.
 
@@ -42,10 +51,14 @@ import jax.numpy as jnp
 import jax.tree_util as jtu
 import optax
 
-try:  # jax >= 0.4.x moves core around; eval_jaxpr stays importable from jax.core
+try:  # verified present on the pinned jax (0.9.0); there is NO public
+    # fallback evaluator (jax.extend.core exports ClosedJaxpr but not
+    # eval_jaxpr, and jax.core.jaxpr_as_fun is gone), so absence makes the
+    # split step unavailable — surfaced as a clear error at build time
+    # rather than a broken import mid-step.
     from jax.core import eval_jaxpr
 except ImportError:  # pragma: no cover
-    from jax.extend.core import eval_jaxpr  # type: ignore
+    eval_jaxpr = None
 
 from raft_stereo_tpu.training.loss import (loss_mask, sequence_loss,
                                            sequence_loss_fused)
@@ -71,28 +84,43 @@ def make_split_train_step(model, tx: optax.GradientTransformation,
     Python-level composition: each call issues four device dispatches that
     queue asynchronously; the caller's metric fetch synchronizes, exactly as
     with the monolithic jitted step.
+
+    ``batch_stats`` is threaded through the jitted pieces as a traced
+    argument (not baked at first call), so reusing the returned callable with
+    a different state — e.g. a restored checkpoint with real running stats —
+    computes with THAT state's stats. The complementary param halves each
+    piece closes over (``rest`` inside the encode stage, ``enc`` inside the
+    refine stage) are structurally required by flax but computationally dead
+    in their stage, so baking their first-call values is sound; the cache key
+    still includes both treedefs so a structurally different state triggers a
+    rebuild instead of a silent mismatch.
     """
+    if eval_jaxpr is None:  # pragma: no cover
+        raise RuntimeError(
+            "split-compilation step unavailable: this jax version exports no "
+            "jaxpr evaluator (jax.core.eval_jaxpr); use the monolithic step "
+            "or remat_encoders instead")
     cache: Dict[Any, Any] = {}
 
     def build(state, batch):
         img_sd = jax.eval_shape(lambda b: b["image1"], batch)
         enc_params0, rest_params0 = _split_params(state.params)
-        bs = state.batch_stats
         cell: Dict[str, Any] = {}
 
-        def enc_only(enc_p, img1, img2):
+        def enc_only(enc_p, bs, img1, img2):
             variables = {"params": {**enc_p, **rest_params0},
                          "batch_stats": bs}
             return model.apply(variables, img1, img2, stage="encode")
 
         # cotangent example for tracing the backward jaxpr (encoder-output
         # structured zeros)
-        eo_sd = jax.eval_shape(enc_only, enc_params0, jnp.zeros(
-            img_sd.shape, img_sd.dtype), jnp.zeros(img_sd.shape, img_sd.dtype))
+        eo_sd = jax.eval_shape(enc_only, enc_params0, state.batch_stats,
+                               jnp.zeros(img_sd.shape, img_sd.dtype),
+                               jnp.zeros(img_sd.shape, img_sd.dtype))
         ct_example = jtu.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), eo_sd)
 
-        def enc_fwd(enc_p, img1, img2):
-            out, vjp = jax.vjp(lambda p: enc_only(p, img1, img2), enc_p)
+        def enc_fwd(enc_p, bs, img1, img2):
+            out, vjp = jax.vjp(lambda p: enc_only(p, bs, img1, img2), enc_p)
             closed = jax.make_jaxpr(vjp)(ct_example)
             # the jaxpr is static IR (no tracers) — safe to stash; its
             # constants are this trace's residual tensors, returned as
@@ -102,7 +130,7 @@ def make_split_train_step(model, tx: optax.GradientTransformation,
 
         piece_enc = jax.jit(enc_fwd)
 
-        def main_grads(rest_p, enc_outs, batch):
+        def main_grads(rest_p, bs, enc_outs, batch):
             def loss_fn(p, eo):
                 variables = {"params": {**enc_params0, **p},
                              "batch_stats": bs}
@@ -123,7 +151,7 @@ def make_split_train_step(model, tx: optax.GradientTransformation,
                 loss_fn, argnums=(0, 1), has_aux=True)(rest_p, enc_outs)
             return g_rest, g_eo, dict(metrics, loss=loss)
 
-        piece_main = jax.jit(main_grads, donate_argnums=(1,))
+        piece_main = jax.jit(main_grads, donate_argnums=(2,))
 
         enc_tree = jtu.tree_structure((enc_params0,))
 
@@ -152,18 +180,20 @@ def make_split_train_step(model, tx: optax.GradientTransformation,
         return entry
 
     def step(state: TrainState, batch):
-        key = tuple(jnp.shape(batch[k]) for k in
-                    ("image1", "image2", "flow", "valid"))
+        key = (tuple(jnp.shape(batch[k]) for k in
+                     ("image1", "image2", "flow", "valid")),
+               jtu.tree_structure((state.params, state.batch_stats)))
         entry = cache.get(key)
         if entry is None:
             entry = cache[key] = build(state, batch)
         enc_p, rest_p = _split_params(state.params)
-        enc_outs, consts = entry["enc"](enc_p, batch["image1"],
-                                        batch["image2"])
+        enc_outs, consts = entry["enc"](enc_p, state.batch_stats,
+                                        batch["image1"], batch["image2"])
         if entry["bwd"] is None:
             # the enc jit trace has now populated the backward jaxpr
             entry["bwd"] = entry["make_bwd"]()
-        g_rest, g_eo, metrics = entry["main"](rest_p, enc_outs, batch)
+        g_rest, g_eo, metrics = entry["main"](rest_p, state.batch_stats,
+                                              enc_outs, batch)
         g_enc = entry["bwd"](consts, g_eo)
         grads = {**g_enc, **g_rest}
         new_state = entry["opt"](state, grads)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# r4 A/B chain on the real chip: isolate the fused-lookup kernel's and the
+# upsample-remat's contributions at the SceneFlow b8 recipe, then probe the
+# schedules the AOT memory fix may have unlocked. Run on an OTHERWISE IDLE
+# host (the lagged-fetch timing protocol is dispatch-sensitive on 1 core).
+set -u
+cd "$(dirname "$0")/.."
+R='{"batch": 8, "h": 320, "w": 720, "train_iters": 22, "steps": 6, "fused_loss": true'
+run() {
+  echo "=== $1"
+  timeout 1500 python bench.py --attempt "$2" 2>&1 | grep -E "BENCH_RESULT|Error|Exceeded|RESOURCE" | tail -2
+}
+run "banker blocks + fused_lookup OFF (r2 config + upsample remat)" "$R, \"remat_encoders\": \"blocks\", \"fused_lookup\": false}"
+run "banker blocks + fused_lookup ON" "$R, \"remat_encoders\": \"blocks\"}"
+run "norms monolith + fused ON (no conv re-runs)" "$R, \"remat_encoders\": \"norms\"}"
+run "plain monolith (the primary)" "$R}"
+run "b4 deferred-fused + ON" '{"batch": 4, "h": 320, "w": 720, "train_iters": 22, "steps": 6, "fused_loss": true}'
+run "b4 deferred-fused + OFF" '{"batch": 4, "h": 320, "w": 720, "train_iters": 22, "steps": 6, "fused_loss": true, "fused_lookup": false}'

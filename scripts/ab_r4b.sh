@@ -1,0 +1,13 @@
+#!/bin/bash
+# r4 follow-up A/B: re-run the flaked fused-OFF control, and probe whether
+# the r3 lax.map upsample chunking (bounded a residual the r4 remat removed)
+# now just costs time at b8.
+set -u
+cd "$(dirname "$0")/.."
+R='{"batch": 8, "h": 320, "w": 720, "train_iters": 22, "steps": 6, "fused_loss": true'
+run() {
+  echo "=== $1"
+  timeout 1500 python bench.py --attempt "$2" 2>&1 | grep -E "BENCH_RESULT|Error|Exceeded|RESOURCE" | tail -2
+}
+run "banker blocks + fused_lookup OFF (control, re-run)" "$R, \"remat_encoders\": \"blocks\", \"fused_lookup\": false}"
+RAFT_UPSAMPLE_BUDGET=2147483648 run "banker blocks + ON + one-shot upsample (budget 2G)" "$R, \"remat_encoders\": \"blocks\"}"

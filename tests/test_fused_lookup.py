@@ -1,0 +1,176 @@
+"""Fused pyramid-lookup+convc1 kernel (ops/pallas/lookup_kernels.py).
+
+Kernel-level parity against the pure-JAX composition (windowed_linear_sample
+pyramid + 1x1 conv + ReLU) for forward and every gradient, plus end-to-end
+model equivalence fused vs unfused — the same test shape/strategy the r3
+full-fusion kernel used (its compile-tractable replacement keeps the same
+oracle discipline). Runs in interpreter mode on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import create_model, init_model
+from raft_stereo_tpu.ops.pallas.lookup_kernels import (
+    fused_lookup_applicable,
+    fused_lookup_c1,
+)
+from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+RADIUS = 4
+
+
+def make_pyramid(seed=0, b=2, h=16, w=128, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    levels = tuple(
+        jnp.asarray(rng.normal(size=(b, h, w, w >> i)), dtype)
+        for i in range(4))
+    coords = jnp.asarray(rng.uniform(-3, w + 3, (b, h, w)), jnp.float32)
+    cc = 4 * (2 * RADIUS + 1)
+    kern = jnp.asarray(rng.normal(size=(cc, 64)) * 0.1, jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(64,)) * 0.1, jnp.float32)
+    return levels, coords, kern, bias
+
+
+def reference(levels, coords, kern, bias):
+    outs = [windowed_linear_sample(v, coords / (2 ** i), RADIUS)
+            for i, v in enumerate(levels)]
+    corr = jnp.concatenate(outs, -1)
+    return jax.nn.relu(jnp.einsum("bhwc,cd->bhwd", corr, kern) + bias)
+
+
+def test_applicable():
+    levels, *_ = make_pyramid()
+    assert fused_lookup_applicable(levels, RADIUS)
+    # too-narrow coarsest level
+    assert not fused_lookup_applicable(
+        tuple(jnp.zeros((1, 8, 32, 32 >> i)) for i in range(4)), RADIUS)
+    # wrong level count
+    assert not fused_lookup_applicable(levels[:3], RADIUS)
+
+
+def test_forward_matches_composition():
+    levels, coords, kern, bias = make_pyramid()
+    out = fused_lookup_c1(levels, coords, kern, bias, RADIUS, None)
+    ref = reference(levels, coords, kern, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_forward_bf16_volume():
+    levels, coords, kern, bias = make_pyramid(dtype=jnp.bfloat16)
+    out = fused_lookup_c1(levels, coords, kern, bias, RADIUS, None)
+    lv32 = tuple(v.astype(jnp.float32) for v in levels)
+    ref = reference(lv32, coords, kern, bias)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), atol=2e-2)
+
+
+def test_gradients_match_composition():
+    levels, coords, kern, bias = make_pyramid(seed=1)
+    ct = jnp.asarray(np.random.default_rng(2).normal(
+        size=(levels[0].shape[0], 16, 128, 64)), jnp.float32)
+
+    def loss(fn):
+        return lambda lv, c, k, b: jnp.sum(fn(lv, c, k, b) * ct)
+
+    g_fused = jax.grad(
+        loss(lambda lv, c, k, b: fused_lookup_c1(lv, c, k, b, RADIUS, None)),
+        argnums=(0, 1, 2, 3))(levels, coords, kern, bias)
+    g_ref = jax.grad(loss(reference),
+                     argnums=(0, 1, 2, 3))(levels, coords, kern, bias)
+    for i in range(4):
+        np.testing.assert_allclose(np.asarray(g_fused[0][i]),
+                                   np.asarray(g_ref[0][i]), atol=1e-5,
+                                   err_msg=f"d_level{i}")
+    # the model detaches coords before the lookup; the kernel's coords
+    # cotangent is structurally zero
+    assert float(jnp.max(jnp.abs(g_fused[1]))) == 0.0
+    np.testing.assert_allclose(np.asarray(g_fused[2]), np.asarray(g_ref[2]),
+                               atol=1e-3, err_msg="d_kernel")
+    np.testing.assert_allclose(np.asarray(g_fused[3]), np.asarray(g_ref[3]),
+                               atol=1e-3, err_msg="d_bias")
+
+
+# ---- end-to-end model equivalence (shape where the kernel engages) ----
+
+H, W = 32, 352  # 1/4-res grid 8x88; pyramid W2s (88, 44, 22, 11)
+ITERS = 2
+
+
+def make_images(seed=0, batch=1):
+    rng = np.random.default_rng(seed)
+    i1 = jnp.asarray(rng.uniform(0, 255, (batch, H, W, 3)), jnp.float32)
+    i2 = jnp.asarray(rng.uniform(0, 255, (batch, H, W, 3)), jnp.float32)
+    return i1, i2
+
+
+def test_fused_engages_at_this_shape():
+    lv = tuple(jnp.zeros((1, H // 4, W // 4, (W // 4) >> i), jnp.float32)
+               for i in range(4))
+    assert fused_lookup_applicable(lv, 4)
+
+
+@pytest.mark.parametrize("mixed", [False, True])
+def test_model_forward_fused_vs_unfused(mixed):
+    cfg_off = RAFTStereoConfig(mixed_precision=mixed, fused_lookup=False)
+    cfg_on = RAFTStereoConfig(mixed_precision=mixed, fused_lookup=True)
+    model_off, variables = init_model(jax.random.PRNGKey(0), cfg_off,
+                                      (1, H, W, 3))
+    model_on = create_model(cfg_on)
+    i1, i2 = make_images()
+    out_off = model_off.apply(variables, i1, i2, iters=ITERS)
+    out_on = model_on.apply(variables, i1, i2, iters=ITERS)
+    a = np.asarray(out_off, np.float32)
+    b = np.asarray(out_on, np.float32)
+    # bf16 GRU iteration compounds rounding differences between the fused
+    # kernel and the XLA graph; fp32 agreement is the exactness check
+    tol = 0.5 if mixed else 2e-3
+    np.testing.assert_allclose(b, a, atol=tol,
+                               err_msg="fused vs unfused predictions")
+
+
+def test_train_step_fused_vs_unfused():
+    i1, i2 = make_images(3)
+    rng = np.random.default_rng(4)
+    batch = {
+        "image1": i1, "image2": i2,
+        "flow": -jnp.asarray(rng.uniform(0, 8, (1, H, W, 1)), jnp.float32),
+        "valid": jnp.ones((1, H, W), jnp.float32),
+    }
+    import optax
+
+    outs = {}
+    for name, fused in (("off", False), ("on", True)):
+        cfg = RAFTStereoConfig(fused_lookup=fused)
+        model, variables = init_model(jax.random.PRNGKey(0), cfg,
+                                      (1, H, W, 3))
+        # SGD(1.0): the parameter delta IS the (negated) gradient, so this
+        # compares raw gradients — Adam's per-element normalization would
+        # amplify fp noise on near-zero-gradient params into O(1) update
+        # differences that say nothing about correctness.
+        tx = optax.sgd(1.0)
+        state = TrainState.create(variables, tx)
+        step = make_train_step(model, tx, ITERS)
+        new_state, metrics = step(state, batch)
+        grads = jax.tree.map(lambda old, new: np.asarray(old - new,
+                                                         np.float32),
+                             state.params, new_state.params)
+        outs[name] = (grads, metrics)
+
+    m_off, m_on = outs["off"][1], outs["on"][1]
+    np.testing.assert_allclose(float(m_on["loss"]), float(m_off["loss"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(m_on["epe"]), float(m_off["epe"]),
+                               rtol=1e-4)
+
+    flat_off = jax.tree_util.tree_leaves_with_path(outs["off"][0])
+    flat_on = jax.tree_util.tree_leaves_with_path(outs["on"][0])
+    gscale = max(np.abs(a).max() for _, a in flat_off) + 1e-6
+    for (path_a, a), (_, b) in zip(flat_off, flat_on):
+        np.testing.assert_allclose(
+            b / gscale, a / gscale, atol=1e-3,
+            err_msg=f"gradient {jax.tree_util.keystr(path_a)}")

@@ -417,3 +417,53 @@ def test_train_step_fused_matches_stacked():
     for a, b in zip(la, lb):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-3, atol=1e-5)
+
+
+# ---- policy-selection pins (VERDICT r3 weak #5) ---------------------------
+#
+# The remat/save/fold/split heuristics carry one-point calibration constants
+# measured on a 16 GB v5e. These tests pin WHICH policy engages at the
+# SceneFlow-calibrated shapes, so a drifted estimate (or an edited constant)
+# fails loudly here instead of silently mistuning the training step.
+
+def test_policy_selection_pins_sceneflow_shapes():
+    import jax.numpy as jnp
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models.raft_stereo import (
+        fold_enc_saves_auto,
+        refinement_save_policy_fits,
+        upsample_chunk_count,
+    )
+    from raft_stereo_tpu.nn.gru import split_conv_engages
+
+    cfg = RAFTStereoConfig(mixed_precision=True,
+                           corr_storage_dtype="bfloat16")
+    # SceneFlow recipe: 320x720 crop, 22 iters, 1/4-res grid 80x180.
+    it, h, w = 22, 80, 180
+
+    # Selective save policy: engages at b4 bf16 (1.36 GB est.), inverts to
+    # full remat at b8 (measured 1085 vs 879 ms — PERF.md r2).
+    assert refinement_save_policy_fits(cfg, it, 4, h, w, jnp.bfloat16)
+    assert not refinement_save_policy_fits(cfg, it, 8, h, w, jnp.bfloat16)
+    # fp32 halves the eligible batch.
+    assert refinement_save_policy_fits(cfg, it, 2, h, w, None)
+    assert not refinement_save_policy_fits(cfg, it, 4, h, w, None)
+
+    # Folded encoder saves under "norms": fold at b8 (14.06 GB padded
+    # measured), stay unfolded at b4 (folding cost -65 ms/step).
+    assert fold_enc_saves_auto(cfg, 8, 320, 720)
+    assert not fold_enc_saves_auto(cfg, 4, 320, 720)
+
+    # Post-scan upsample chunking: b8 320x720 i22 busts the 1 GB budget and
+    # chunks; b2 fits one-shot; and when even one iteration busts a tiny
+    # budget the fallback is maximal chunking, never one-shot.
+    assert upsample_chunk_count(it, 8, h, w, 4) > 1
+    assert upsample_chunk_count(it, 2, h, w, 4) == 1
+    assert upsample_chunk_count(it, 8, h, w, 4, budget=1) == it
+
+    # Split-input gate convs: engage at the 80x180 train grid, not at the
+    # realtime preset's 47x156 1/8-res grid (measured ~25% FPS regression
+    # there).
+    assert split_conv_engages(80, 180)
+    assert not split_conv_engages(47, 156)
