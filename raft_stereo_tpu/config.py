@@ -85,6 +85,14 @@ class RAFTStereoConfig:
     # 128-lane tile). None = auto by estimated padded size (folds at the
     # SceneFlow b8 shape, not at b4); bool forces.
     fold_enc_saves: Optional[bool] = None
+    # Ours: fp32 working-set budget (bytes) for the post-scan batched
+    # upsample before it is chunked over the iteration axis (lax.map
+    # serialization — bounds the peak temp at the cost of per-chunk
+    # dispatch + stack copies). None = the model default
+    # (models/raft_stereo.py _UPSAMPLE_TILE_BUDGET); with the r4
+    # rematerialized loss tail the one-shot schedule's temps are transient,
+    # so a larger budget trades peak memory back for speed.
+    upsample_tile_budget: Optional[int] = None
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)

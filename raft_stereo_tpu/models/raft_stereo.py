@@ -104,9 +104,7 @@ def upsample_chunk_count(it: int, batch: int, hp: int, wp: int, factor: int,
     exceeds it — never the worst-memory one-shot path when memory is
     tightest."""
     if budget is None:
-        import os
-        budget = int(os.environ.get("RAFT_UPSAMPLE_BUDGET",
-                                    _UPSAMPLE_TILE_BUDGET))
+        budget = _UPSAMPLE_TILE_BUDGET
     tile_bytes = batch * hp * wp * (9 + 2) * factor ** 2 * 4
     nch = 1
     if it * tile_bytes > budget:
@@ -472,7 +470,8 @@ class RAFTStereo(nn.Module):
                 # batching win over in-scan upsampling; shapes whose full
                 # temp already fits stay one-shot (chunking is lax.map
                 # serialization — pure cost when memory is plentiful).
-                nch = upsample_chunk_count(it, bb, hp, wp, cfg.factor)
+                nch = upsample_chunk_count(it, bb, hp, wp, cfg.factor,
+                                           budget=cfg.upsample_tile_budget)
 
                 # Rematerialized: without the checkpoint, autodiff saves
                 # the upsample's fp32 softmax weights and tile products for

@@ -45,19 +45,51 @@ class _Trunk(nn.Module):
     def __call__(self, x):
         d = self.dtype
         fs = self.fold_saves
-        RB = nn.remat(ResidualBlock) if self.remat_blocks else ResidualBlock
+
+        if self.remat_blocks:
+            # Remat each block with a LANE-DENSE boundary: jax.checkpoint
+            # saves the wrapped function's inputs across the backward, and a
+            # sub-128-channel full-resolution activation saved as-is is
+            # padded 2x on the 128-lane tile (2x 900 MB for the fnet layer1
+            # saves alone at SceneFlow b8 — r4 AOT breakdown). Folding W
+            # into channels up to a 128 multiple makes the SAVED form
+            # exactly lane-sized; the in-region unfold is a transient
+            # relayout the backward recompute repeats.
+            def _rb(in_planes, planes, stride, name):
+                block = ResidualBlock(in_planes, planes, self.norm_fn,
+                                      stride, d, fs, name=name)
+
+                def apply_block(x):
+                    b, h, w, c = x.shape
+                    factor = 1
+                    if c % 128:  # already lane-sized saves gain nothing
+                        for f in (2, 4):
+                            if (c * f) % 128 == 0 and w % f == 0:
+                                factor = f
+                                break
+                    if factor == 1:
+                        return nn.remat(
+                            lambda mdl, v: mdl(v))(block, x)
+                    xf = x.reshape(b, h, w // factor, factor * c)
+                    return nn.remat(
+                        lambda mdl, v: mdl(v.reshape(b, h, w, c)))(block, xf)
+
+                return apply_block
+        else:
+            def _rb(in_planes, planes, stride, name):
+                return ResidualBlock(in_planes, planes, self.norm_fn, stride,
+                                     d, fs, name=name)
+
         x = save_conv_output(
             Conv.make(64, 7, 1 + (self.downsample > 2), 3, d, "conv1")(x), fs)
         x = apply_norm(make_norm(self.norm_fn, 64, num_groups=8, name="norm1"), x)
         x = nn.relu(x)
-        x = RB(64, 64, self.norm_fn, 1, d, fs, name="layer1_0")(x)
-        x = RB(64, 64, self.norm_fn, 1, d, fs, name="layer1_1")(x)
-        x = RB(64, 96, self.norm_fn, 1 + (self.downsample > 1), d, fs,
-               name="layer2_0")(x)
-        x = RB(96, 96, self.norm_fn, 1, d, fs, name="layer2_1")(x)
-        x = RB(96, 128, self.norm_fn, 1 + (self.downsample > 0), d, fs,
-               name="layer3_0")(x)
-        x = RB(128, 128, self.norm_fn, 1, d, fs, name="layer3_1")(x)
+        x = _rb(64, 64, 1, "layer1_0")(x)
+        x = _rb(64, 64, 1, "layer1_1")(x)
+        x = _rb(64, 96, 1 + (self.downsample > 1), "layer2_0")(x)
+        x = _rb(96, 96, 1, "layer2_1")(x)
+        x = _rb(96, 128, 1 + (self.downsample > 0), "layer3_0")(x)
+        x = _rb(128, 128, 1, "layer3_1")(x)
         return x
 
 

@@ -10,4 +10,4 @@ run() {
   timeout 1500 python bench.py --attempt "$2" 2>&1 | grep -E "BENCH_RESULT|Error|Exceeded|RESOURCE" | tail -2
 }
 run "banker blocks + fused_lookup OFF (control, re-run)" "$R, \"remat_encoders\": \"blocks\", \"fused_lookup\": false}"
-RAFT_UPSAMPLE_BUDGET=2147483648 run "banker blocks + ON + one-shot upsample (budget 2G)" "$R, \"remat_encoders\": \"blocks\"}"
+run "banker blocks + ON + one-shot upsample (budget 2G)" "$R, \"remat_encoders\": \"blocks\", \"upsample_budget\": 2147483648}"

@@ -95,6 +95,44 @@ def test_gradients_match_composition():
                                atol=1e-3, err_msg="d_bias")
 
 
+def test_multiblock_grid_forward_and_gradients():
+    """h=32 resolves ``_pick_hb`` to 16 -> TWO row blocks per batch: covers
+    the j-axis BlockSpec index maps, the disjoint per-row-block d_volume
+    writes, and the dk/db accumulation across grid steps that single-block
+    shapes never reach (the coverage the removed full-fusion kernel's
+    multiblock test provided)."""
+    from raft_stereo_tpu.ops.pallas.lookup_kernels import _pick_hb
+
+    levels, coords, kern, bias = make_pyramid(seed=5, b=2, h=32, w=128)
+    w2s = tuple(v.shape[-1] for v in levels)
+    hb = _pick_hb(32, 128, w2s, levels[0].dtype.itemsize)
+    assert 0 < hb < 32, f"expected a multi-block grid, got hb={hb}"
+
+    out = fused_lookup_c1(levels, coords, kern, bias, RADIUS, None)
+    ref = reference(levels, coords, kern, bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    ct = jnp.asarray(np.random.default_rng(6).normal(
+        size=out.shape), jnp.float32)
+
+    def loss(fn):
+        return lambda lv, c, k, b: jnp.sum(fn(lv, c, k, b) * ct)
+
+    g_fused = jax.grad(
+        loss(lambda lv, c, k, b: fused_lookup_c1(lv, c, k, b, RADIUS, None)),
+        argnums=(0, 2, 3))(levels, coords, kern, bias)
+    g_ref = jax.grad(loss(reference),
+                     argnums=(0, 2, 3))(levels, coords, kern, bias)
+    for i in range(4):
+        np.testing.assert_allclose(np.asarray(g_fused[0][i]),
+                                   np.asarray(g_ref[0][i]), atol=1e-5,
+                                   err_msg=f"d_level{i} (multiblock)")
+    np.testing.assert_allclose(np.asarray(g_fused[1]), np.asarray(g_ref[1]),
+                               atol=1e-3, err_msg="d_kernel (multiblock)")
+    np.testing.assert_allclose(np.asarray(g_fused[2]), np.asarray(g_ref[2]),
+                               atol=1e-3, err_msg="d_bias (multiblock)")
+
+
 # ---- end-to-end model equivalence (shape where the kernel engages) ----
 
 H, W = 32, 352  # 1/4-res grid 8x88; pyramid W2s (88, 44, 22, 11)
