@@ -99,3 +99,24 @@ def test_split_params_partition():
         assert not (set(enc) & set(rest))
         assert "cnet" in enc
         assert "refinement" in rest
+
+
+def test_split_step_composes_with_norms_remat():
+    """The bench's split+norms experiment path: with remat_encoders="norms"
+    the policy's nn.remat lives inside the encode stage, so piece_enc's
+    traced-vjp residuals are the policy's saved set (conv outputs + stats)
+    — the schedule that fits batch 8 where full residuals OOM'd. Must be
+    the monolithic norms step's math."""
+    model, tx, state, batch = _setup(dict(remat_encoders="norms"))
+    mono = jax.jit(make_train_step(model, tx, train_iters=2, fused_loss=True))
+    s_ref, m_ref = mono(_fresh(state), batch)
+
+    split = make_split_train_step(model, tx, train_iters=2, fused_loss=True)
+    s_got, m_got = split(_fresh(state), batch)
+
+    assert float(m_got["loss"]) == pytest.approx(float(m_ref["loss"]),
+                                                 rel=1e-3)
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
+                    jax.tree_util.tree_leaves(s_got.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-2, atol=5e-4)
