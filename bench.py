@@ -45,7 +45,7 @@ _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "4800"))
 
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               remat_encoders=False, split_step=False, fused_lookup=None,
-              upsample_budget=None):
+              upsample_budget=None, fused_flow=None):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
     # degraded windows (r3: every big graph rejected; r4: wedged for hours);
@@ -72,7 +72,8 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
                            corr_storage_dtype="bfloat16",
                            remat_encoders=remat_encoders,
                            fused_lookup=fused_lookup,
-                           upsample_tile_budget=upsample_budget)
+                           upsample_tile_budget=upsample_budget,
+                           fused_flow=fused_flow)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 
@@ -195,6 +196,13 @@ def _attempt_chain(on_tpu):
         dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
                      upsample_budget=2_147_483_648, **recipe),
              when="always", note="one-shot upsample experiment"),
+        # Experiment: flow-branch Pallas kernel + one-shot upsample — the
+        # fused_flow default is OFF pending exactly this measurement
+        # (config.py); a win here is the data that flips it.
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
+                     upsample_budget=2_147_483_648, fused_flow=True,
+                     **recipe),
+             when="always", note="one-shot + fused flow-branch experiment"),
         # Experiment: split-compilation composed with the "norms" encoder
         # residual policy — piece_enc emits ~7 GB of conv-output residuals
         # instead of the 24.9 GB full set that OOM'd the r3 split attempt,

@@ -70,6 +70,16 @@ class RAFTStereoConfig:
     # path strips it (no partitioning rule for the kernel). Explicit
     # True/False forces where applicable / everywhere off.
     fused_lookup: Optional[bool] = None
+    # Ours: run the motion encoder's flow branch entry (``convf1`` — a 7x7
+    # conv on the 1-channel epipolar flow, the XLA graph's worst fusion at
+    # 2.7 TF/s for its weight grad) as a Pallas kernel that derives flow
+    # from the detached coords in-kernel (ops/pallas/lookup_kernels.py::
+    # fused_flow_f1, numerically exact vs the XLA graph). None = auto,
+    # currently OFF: the kernel is CPU-verified but its TPU step-time
+    # contribution is unmeasured (the r4 compile service outage blocked the
+    # A/B); the bench chain carries an ON experiment so the measurement
+    # happens at bench time, and the default flips with data.
+    fused_flow: Optional[bool] = None
     # Ours: rematerialize the encoders in the backward pass. Their
     # full-resolution conv1/layer1 activations are multi-GB backward
     # residuals at train shapes. True = recompute both whole encoders

@@ -144,6 +144,7 @@ class RefinementStep(nn.Module):
     deferred: bool = False
     dtype: Optional[Dtype] = None
     fused_lookup: bool = False
+    fused_flow: bool = False
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
@@ -175,7 +176,9 @@ class RefinementStep(nn.Module):
             net, inp_list, corr, flow.astype(dt) if dt else flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
             corr_state=corr_state if self.fused_lookup else None,
-            coords_x=coords1[..., 0] if self.fused_lookup else None)
+            coords_x=(coords1[..., 0]
+                      if self.fused_lookup or self.fused_flow else None),
+            fused_flow=self.fused_flow)
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
@@ -380,6 +383,14 @@ class RAFTStereo(nn.Module):
                 fused_lookup_applicable)
             use_fused_lookup = fused_lookup_applicable(corr_state.levels,
                                                        cfg.corr_radius)
+        # Flow-branch kernel: auto currently resolves OFF (CPU-verified,
+        # TPU contribution unmeasured — config.py fused_flow).
+        use_fused_flow = False
+        if cfg.fused_flow:
+            from raft_stereo_tpu.ops.pallas.lookup_kernels import (
+                fused_flow_f1_applicable)
+            gh, gw = net_list[0].shape[1], net_list[0].shape[2]
+            use_fused_flow = fused_flow_f1_applicable(gh, gw)
 
         b, h, w, _ = net_list[0].shape
         coords0 = coords_grid(b, h, w)
@@ -436,7 +447,8 @@ class RAFTStereo(nn.Module):
             out_axes=0,
             length=iters,
         )(cfg, test_mode, fused, deferred, dt,
-          fused_lookup=use_fused_lookup, name="refinement")
+          fused_lookup=use_fused_lookup, fused_flow=use_fused_flow,
+          name="refinement")
         gt_and_mask = None
         if fused:
             gt_and_mask = (flow_gt.astype(jnp.float32),
