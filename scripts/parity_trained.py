@@ -170,6 +170,11 @@ def main():
         "fp32+alt_pallas": create_model(RAFTStereoConfig(
             corr_implementation="alt_pallas",
             corr_storage_dtype="float32")),
+        # r4 fused kernels: 4-level pyramid lookup + convc1 in one Pallas
+        # kernel (fused_lookup) and the flow-branch convf1 kernel
+        # (fused_flow) — the default/experimental TPU hot path.
+        "fp32+fused_r4": create_model(RAFTStereoConfig(
+            fused_lookup=True, fused_flow=True)),
     }
     variants = {
         **gated,
