@@ -47,6 +47,14 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    help="correlation-volume storage precision; default "
                         "matches the reference (fp32 for reg/alt, compute "
                         "dtype for the *_pallas kernels)")
+    g.add_argument("--fused_lookup", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused pyramid-lookup+convc1 Pallas kernel "
+                        "(auto: on for TPU backends where shapes fit)")
+    g.add_argument("--fused_flow", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="flow-branch convf1 Pallas kernel (auto: currently "
+                        "off pending TPU measurement — see config.py)")
 
 
 def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
@@ -63,6 +71,10 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         mixed_precision=args.mixed_precision,
         remat_refinement=not getattr(args, "no_remat", False),
         corr_storage_dtype=getattr(args, "corr_storage_dtype", None),
+        fused_lookup={"auto": None, "on": True, "off": False}[
+            getattr(args, "fused_lookup", "auto")],
+        fused_flow={"auto": None, "on": True, "off": False}[
+            getattr(args, "fused_flow", "auto")],
     )
 
 

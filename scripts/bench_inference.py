@@ -28,6 +28,10 @@ def main():
                         help="refinement iterations (default: 32 / 7)")
     parser.add_argument("--size", type=int, nargs=2, default=[375, 1242])
     parser.add_argument("--frames", type=int, default=12)
+    parser.add_argument("--fused_lookup", choices=["auto", "on", "off"],
+                        default="auto")
+    parser.add_argument("--fused_flow", choices=["auto", "on", "off"],
+                        default="auto")
     args = parser.parse_args()
 
     import jax
@@ -40,6 +44,11 @@ def main():
         "default": (RAFTStereoConfig(mixed_precision=True), 32),
         "realtime": (realtime_config(), 7),
     }
+    tri = {"auto": None, "on": True, "off": False}
+    import dataclasses
+    presets = {k: (dataclasses.replace(c, fused_lookup=tri[args.fused_lookup],
+                                       fused_flow=tri[args.fused_flow]), it)
+               for k, (c, it) in presets.items()}
     chosen = ["default", "realtime"] if args.preset == "both" else [args.preset]
 
     h, w = args.size
