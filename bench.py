@@ -296,32 +296,46 @@ def main():
     # own wall clock counts against the deadline.
     t_start = time.monotonic()
     on_tpu = _probe_on_tpu()
-
-    best = None
-    for att in _attempt_chain(on_tpu):
-        if att["when"] == "unbanked" and best is not None:
-            continue
-        if (att["when"] == "below_par" and best is not None
-                and best["value"] >= _PAR_PAIRS_PER_SEC):
-            continue
-        if time.monotonic() - t_start > _DEADLINE_S:
-            print("bench deadline reached; stopping the chain",
-                  file=sys.stderr)
-            break
-        result = _run_attempt_subprocess(att["kw"], att.get("timeout_s"))
-        if result is None:
-            continue
-        if att["note"]:
-            result["note"] = att["note"]
-        print(f"bench attempt ok: {result}", file=sys.stderr)
-        if best is None or result["value"] > best["value"]:
-            best = result
-
+    best = run_chain(_attempt_chain(on_tpu), _run_attempt_subprocess,
+                     t_start=t_start)
     if best is None:
         print("all bench attempts failed", file=sys.stderr)
         return 1
     print(json.dumps(best))
     return 0
+
+
+def run_chain(attempts, runner, t_start=None, deadline_s=None):
+    """Drive the attempt chain: gate by ``when`` tier, keep the best result.
+
+    Separated from main() so the gating policy — the part that decides
+    whether the round reports a number at all — is unit-testable with a
+    stubbed runner (tests/test_bench_chain.py).
+    """
+    if t_start is None:
+        t_start = time.monotonic()
+    if deadline_s is None:
+        deadline_s = _DEADLINE_S
+    best = None
+    for att in attempts:
+        if att["when"] == "unbanked" and best is not None:
+            continue
+        if (att["when"] == "below_par" and best is not None
+                and best["value"] >= _PAR_PAIRS_PER_SEC):
+            continue
+        if time.monotonic() - t_start > deadline_s:
+            print("bench deadline reached; stopping the chain",
+                  file=sys.stderr)
+            break
+        result = runner(att["kw"], att.get("timeout_s"))
+        if result is None:
+            continue
+        if att.get("note"):
+            result["note"] = att["note"]
+        print(f"bench attempt ok: {result}", file=sys.stderr)
+        if best is None or result["value"] > best["value"]:
+            best = result
+    return best
 
 
 if __name__ == "__main__":
