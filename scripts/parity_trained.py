@@ -293,6 +293,8 @@ def realtime_parity(args, make_pair, epe):
         "rt-fp32+reg_pallas": create_model(RAFTStereoConfig(
             **base, corr_implementation="reg_pallas",
             corr_storage_dtype="float32")),
+        "rt-fp32+fused_r4": create_model(RAFTStereoConfig(
+            **base, fused_lookup=True, fused_flow=True)),
     }
     variants = {
         **gated,
