@@ -6,6 +6,7 @@ stubbed attempt runner.
 """
 
 import sys
+import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
@@ -110,10 +111,26 @@ def test_all_fail_returns_none():
 def test_deadline_stops_chain_but_keeps_best():
     chain = _chain(("banker", "always", None),
                    ("exp", "always", "experiment"))
-    run, calls = _runner([("banker", _res(9.4))])
-    best = bench.run_chain(chain, run, t_start=0.0)  # deadline long passed
-    assert best is None or calls == []  # nothing ran past the deadline
-    # with a sane start time everything runs
+    # Deadline expired before the chain starts: nothing may run. (An
+    # explicit t_start/deadline_s pair — NOT t_start=0.0, which only means
+    # "expired" when the host's monotonic clock exceeds _DEADLINE_S.)
+    run, calls = _runner([])
+    best = bench.run_chain(chain, run,
+                           t_start=time.monotonic() - 10.0, deadline_s=1.0)
+    assert best is None and calls == []
+    # Deadline trips mid-chain, after the banker banked a result: the
+    # remaining attempts are skipped but the banked best is still returned.
+    inner, calls_mid = _runner([("banker", _res(9.4))])
+
+    def slow_run(kw, timeout_s=None):
+        result = inner(kw, timeout_s)
+        time.sleep(0.05)
+        return result
+
+    best_mid = bench.run_chain(chain, slow_run, deadline_s=0.02)
+    assert best_mid["value"] == 9.4
+    assert calls_mid == ["banker"]
+    # with a sane deadline everything runs
     run2, calls2 = _runner([("banker", _res(9.4)), ("exp", None)])
     best2 = bench.run_chain(chain, run2)
     assert best2["value"] == 9.4
