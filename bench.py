@@ -45,7 +45,8 @@ _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "4800"))
 
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               remat_encoders=False, split_step=False, fused_lookup=None,
-              upsample_budget=None, fused_flow=None):
+              upsample_budget=None, remat_loss_tail=True,
+              fold_enc_saves=None, scan_unroll=1):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
     # degraded windows (r3: every big graph rejected; r4: wedged for hours);
@@ -73,7 +74,9 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
                            remat_encoders=remat_encoders,
                            fused_lookup=fused_lookup,
                            upsample_tile_budget=upsample_budget,
-                           fused_flow=fused_flow)
+                           remat_loss_tail=remat_loss_tail,
+                           fold_enc_saves=fold_enc_saves,
+                           scan_unroll=scan_unroll)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 
@@ -148,10 +151,11 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
     }
 
 
-# r2's proven blocks-remat number: attempts marked "below_par" keep running
+# r4's measured banker number (blocks-remat + one-shot upsample + saved
+# loss tail + unfolded saves): attempts marked "below_par" keep running
 # until the banked best reaches it, so regressions in newer paths can't
 # silently cap the round.
-_PAR_PAIRS_PER_SEC = 9.3
+_PAR_PAIRS_PER_SEC = 9.4
 
 
 def _attempt_chain(on_tpu):
@@ -166,54 +170,39 @@ def _attempt_chain(on_tpu):
         return [dict(kw=dict(batch=2, h=96, w=160, train_iters=4, steps=3),
                      when="always", note=None)]
     recipe = dict(h=320, w=720, train_iters=22, steps=6)
+    # The r4-measured winning schedule (9.42 pairs/s): one-shot post-scan
+    # upsample (the lax.map chunking's serialization cost -0.12), SAVED
+    # loss tail (the rematerialized tail's backward recompute cost -0.2;
+    # its residency fits b8 alongside UNFOLDED blocks-remat saves, whose
+    # lane-dense fold cost -0.39). fused_lookup auto already resolves OFF
+    # (-1.5, PERF.md "r4 A/B").
+    best_sched = dict(upsample_budget=2_147_483_648, remat_loss_tail=False,
+                      fold_enc_saves=False)
     return [
         # Primary: monolithic deferred-upsample + fused-loss b8 — the fastest
         # variant IF the compile service accepts it (it has rejected every
-        # monolithic b8 graph since r1, but a healthy helper should take
-        # it). Tighter timeout: when it fails it fails by AOT-OOM within
-        # ~5 min; a wedged helper must not eat the banker's slot.
-        dict(kw=dict(batch=8, fused_loss=True, **recipe),
+        # monolithic b8 graph since r1, but a healthy helper could take
+        # it). Tighter timeout: when it fails it fails by AOT-OOM or HTTP
+        # 500 within ~5 min; a wedged helper must not eat the banker's slot.
+        dict(kw=dict(batch=8, fused_loss=True, **best_sched, **recipe),
              when="always", note=None, timeout_s=900),
-        # BANKER: r2's proven number (9.32 pairs/s) — block-granular encoder
-        # remat shrinks the graph below the degraded helper's threshold.
-        # Runs immediately after the primary so a number is banked before
-        # anything experimental.
+        # BANKER: block-granular encoder remat shrinks the graph below the
+        # helper's rejection threshold; with the r4 best schedule this
+        # measured 9.42 pairs/s. below_par (not unbanked): even if the
+        # primary lands, a below-par primary must not cap the round.
+        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
+                     **best_sched, **recipe),
+             when="below_par", note="blocks-remat banker, r4 best schedule"),
+        # Memory-safe insurance: rematerialized loss tail + default
+        # (chunk-on-pressure) upsample budget trades ~0.6 pairs/s for
+        # ~2-3 GB less residency (8.72-8.84 measured) — for a day when the
+        # banker's saved-tail residency no longer fits.
         dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
                      **recipe),
-             when="unbanked", note="encoder-block-remat banker, same recipe"),
-        # The exact r2-measured banker (fused_lookup pinned OFF): insurance
-        # against a fused-lookup kernel PERFORMANCE regression, not just a
-        # hard failure — it runs whenever the banked best is still below
-        # par (r2's 9.3), so a kernel that works but got slower cannot
-        # silently cap the round's number.
-        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
-                     fused_lookup=False, **recipe),
-             when="below_par", note="blocks-remat banker, unfused lookup"),
-        # Experiment: one-shot post-scan upsample (2 GB budget disables the
-        # r3 lax.map chunking whose serialization/stack copies are the prime
-        # suspect for the r2->r4 step-time regression; with the r4
-        # rematerialized loss tail its temps are transient, not residents).
-        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
-                     upsample_budget=2_147_483_648, **recipe),
-             when="always", note="one-shot upsample experiment"),
-        # Experiment: flow-branch Pallas kernel + one-shot upsample — the
-        # fused_flow default is OFF pending exactly this measurement
-        # (config.py); a win here is the data that flips it.
-        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
-                     upsample_budget=2_147_483_648, fused_flow=True,
-                     **recipe),
-             when="always", note="one-shot + fused flow-branch experiment"),
-        # Experiment: split-compilation composed with the "norms" encoder
-        # residual policy — piece_enc emits ~7 GB of conv-output residuals
-        # instead of the 24.9 GB full set that OOM'd the r3 split attempt,
-        # and piece_bwd recomputes only elementwise glue (no conv re-runs —
-        # the schedule the rejected monolith would run). Could beat the
-        # banker, so it runs even once a number is banked.
-        dict(kw=dict(batch=8, fused_loss=True, remat_encoders="norms",
-                     split_step=True, **recipe),
-             when="always", note="split-step + norms-remat experiment"),
+             when="unbanked", note="rematerialized-tail fallback"),
         # Fallbacks, expected slower than the banker — only run while
-        # nothing is banked.
+        # nothing is banked. (The split-step attempt is gone: its pieces
+        # were helper-rejected at b8 in both r3 and r4 — see PERF.md.)
         dict(kw=dict(batch=8, fused_loss=True, remat_encoders="norms",
                      **recipe),
              when="unbanked", note="norms-remat fallback, same recipe"),

@@ -49,12 +49,15 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                         "dtype for the *_pallas kernels)")
     g.add_argument("--fused_lookup", choices=["auto", "on", "off"],
                    default="auto",
-                   help="fused pyramid-lookup+convc1 Pallas kernel "
-                        "(auto: on for TPU backends where shapes fit)")
-    g.add_argument("--fused_flow", choices=["auto", "on", "off"],
-                   default="auto",
-                   help="flow-branch convf1 Pallas kernel (auto: currently "
-                        "off pending TPU measurement — see config.py)")
+                   help="fused pyramid-lookup+convc1 Pallas kernel (auto: "
+                        "off — measured slower than XLA's unfused path on "
+                        "every surface, PERF.md r4 A/B; 'on' opts in where "
+                        "shapes fit)")
+    g.add_argument("--no_remat_loss_tail", action="store_true",
+                   help="save the post-scan upsample/loss intermediates "
+                        "across the loss backward instead of recomputing "
+                        "them (1.4-1.9 GB extra residency at SceneFlow b8; "
+                        "slightly faster where it fits)")
 
 
 def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
@@ -73,8 +76,7 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         corr_storage_dtype=getattr(args, "corr_storage_dtype", None),
         fused_lookup={"auto": None, "on": True, "off": False}[
             getattr(args, "fused_lookup", "auto")],
-        fused_flow={"auto": None, "on": True, "off": False}[
-            getattr(args, "fused_flow", "auto")],
+        remat_loss_tail=not getattr(args, "no_remat_loss_tail", False),
     )
 
 

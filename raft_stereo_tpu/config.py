@@ -64,22 +64,15 @@ class RAFTStereoConfig:
     # encoder's 1x1 conv into one Pallas kernel with a hand-written VJP
     # (ops/pallas/lookup_kernels.py) — the compile-tractable subset of the
     # r3 full lookup+motion fusion (see that module's doc for why this
-    # scope). None = auto: ON on TPU backends for volume-pyramid corr
-    # implementations whose shapes fit the kernel (4 levels, VMEM budget),
-    # OFF elsewhere (CPU interpret mode is test-only); the auto-SPMD pjit
-    # path strips it (no partitioning rule for the kernel). Explicit
-    # True/False forces where applicable / everywhere off.
+    # scope). None = auto: currently OFF — the kernel compiles in seconds
+    # and is numerically exact (parity-verified, PARITY.md), but the r4
+    # single-chip A/B measured it SLOWER than XLA's unfused lookup+conv on
+    # every surface: SceneFlow-b8 training 7.23 vs 8.72 pairs/s, KITTI-res
+    # inference 6.07 vs 7.39 FPS (default) and 67.4 vs 77.3 FPS (realtime)
+    # — see PERF.md "r4 A/B" for the table and the suspected cause.
+    # Explicit True forces it where shapes fit (the auto-SPMD pjit path
+    # still strips it — no partitioning rule for the kernel).
     fused_lookup: Optional[bool] = None
-    # Ours: run the motion encoder's flow branch entry (``convf1`` — a 7x7
-    # conv on the 1-channel epipolar flow, the XLA graph's worst fusion at
-    # 2.7 TF/s for its weight grad) as a Pallas kernel that derives flow
-    # from the detached coords in-kernel (ops/pallas/lookup_kernels.py::
-    # fused_flow_f1, numerically exact vs the XLA graph). None = auto,
-    # currently OFF: the kernel is CPU-verified but its TPU step-time
-    # contribution is unmeasured (the r4 compile service outage blocked the
-    # A/B); the bench chain carries an ON experiment so the measurement
-    # happens at bench time, and the default flips with data.
-    fused_flow: Optional[bool] = None
     # Ours: rematerialize the encoders in the backward pass. Their
     # full-resolution conv1/layer1 activations are multi-GB backward
     # residuals at train shapes. True = recompute both whole encoders
@@ -90,10 +83,11 @@ class RAFTStereoConfig:
     # (no conv re-runs — the fp32 norm intermediates and bool relu masks
     # are what dominate plain-backward residual memory).
     remat_encoders: "bool | str" = False
-    # Under remat_encoders="norms": save conv outputs in a lane-dense folded
-    # shape (64/96-channel saves are otherwise padded 2x/1.33x to the
-    # 128-lane tile). None = auto by estimated padded size (folds at the
-    # SceneFlow b8 shape, not at b4); bool forces.
+    # Under remat_encoders="norms"/"blocks": save conv outputs ("norms") or
+    # remat-boundary block inputs ("blocks") in a lane-dense folded shape
+    # (64/96-channel saves are otherwise padded 2x/1.33x to the 128-lane
+    # tile). None = auto by estimated padded size (folds at the SceneFlow
+    # b8 shape, not at b4); bool forces.
     fold_enc_saves: Optional[bool] = None
     # Ours: fp32 working-set budget (bytes) for the post-scan batched
     # upsample before it is chunked over the iteration axis (lax.map
@@ -103,6 +97,23 @@ class RAFTStereoConfig:
     # rematerialized loss tail the one-shot schedule's temps are transient,
     # so a larger budget trades peak memory back for speed.
     upsample_tile_budget: Optional[int] = None
+    # Ours: jax.checkpoint around the post-scan upsample/loss tail. True
+    # recomputes the upsample's fp32 softmax/tile intermediates in the
+    # backward instead of saving them across the loss backward (measured
+    # 1.4-1.9 GB at SceneFlow b8 — the difference between fitting a 16 GB
+    # chip and AOT-OOM, r4). False saves them (r2's schedule): one less
+    # batched upsample in the backward, for shapes/chips where the
+    # residency fits. Applies to both the chunked and stacked tails.
+    remat_loss_tail: bool = True
+    # Ours: lax.scan unroll factor for the refinement loop. >1 replicates
+    # the iteration body inside the while loop, amortizing per-iteration
+    # dispatch overhead and letting XLA fuse across consecutive iterations
+    # — at the cost of a proportionally larger graph. Semantically
+    # identical. Measured at SceneFlow b8 (r4): unroll=2 gave 9.23 vs 9.42
+    # pairs/s — the scan body's ops are large enough that dispatch
+    # overhead is not the binding cost there; smaller/lower-batch shapes
+    # may differ, hence the knob.
+    scan_unroll: int = 1
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)

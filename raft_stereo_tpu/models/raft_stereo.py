@@ -144,7 +144,6 @@ class RefinementStep(nn.Module):
     deferred: bool = False
     dtype: Optional[Dtype] = None
     fused_lookup: bool = False
-    fused_flow: bool = False
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
@@ -176,9 +175,7 @@ class RefinementStep(nn.Module):
             net, inp_list, corr, flow.astype(dt) if dt else flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
             corr_state=corr_state if self.fused_lookup else None,
-            coords_x=(coords1[..., 0]
-                      if self.fused_lookup or self.fused_flow else None),
-            fused_flow=self.fused_flow)
+            coords_x=coords1[..., 0] if self.fused_lookup else None)
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
@@ -299,14 +296,22 @@ class RAFTStereo(nn.Module):
             _fnet_fwd = nn.remat(_fnet_fwd, policy=pol)
         remat_blocks = cfg.remat_encoders == "blocks"
 
-        # Lane-dense folded saves under the "norms" policy (see
-        # fold_enc_saves_auto for the calibration).
+        # Lane-dense folded saves under the "norms" and "blocks" policies
+        # (for "blocks" the fold applies to the remat boundary inputs —
+        # encoder.py _Trunk). Auto: "norms" folds by the padded-size
+        # estimate (fold_enc_saves_auto — its 14 GB padded save set
+        # genuinely doesn't fit a 16 GB chip at SceneFlow b8); "blocks"
+        # stays UNFOLDED — its padded saves fit even at b8 with the
+        # one-shot/no-tail schedule, and the fold's relayout copies
+        # measured -0.39 pairs/s there (9.42 vs 9.03, bench r4).
         fold_saves = False
         if cfg.remat_encoders == "norms":
             fold_saves = (cfg.fold_enc_saves if cfg.fold_enc_saves is not None
                           else fold_enc_saves_auto(cfg, image1.shape[0],
                                                    image1.shape[1],
                                                    image1.shape[2]))
+        elif cfg.remat_encoders == "blocks":
+            fold_saves = bool(cfg.fold_enc_saves)
 
         cnet = MultiBasicEncoder(
             output_dim=(cfg.hidden_dims, cfg.hidden_dims),
@@ -371,26 +376,19 @@ class RAFTStereo(nn.Module):
         # Fused lookup+convc1 kernel: applicable only for volume-pyramid
         # implementations whose shapes fit the kernel tiling (the check is
         # static — shapes are known at trace time). Everything else keeps
-        # the unfused path with identical semantics. Auto (None) = ON on
-        # TPU backends (the kernel's compile-tractable scope — see
-        # ops/pallas/lookup_kernels.py); CPU interpret mode is far slower
-        # than XLA, so auto stays off there (tests opt in explicitly).
+        # the unfused path with identical semantics. Auto (None) = OFF:
+        # the kernel is exact and compiles fast, but the r4 TPU A/B
+        # measured it slower than XLA's unfused path on every surface
+        # (training AND no-backward inference — config.py fused_lookup,
+        # PERF.md "r4 A/B"); opt in with fused_lookup=True to re-measure.
         use_fused_lookup = False
-        want_fused = (jax.default_backend() == "tpu"
-                      if cfg.fused_lookup is None else bool(cfg.fused_lookup))
+        want_fused = (False if cfg.fused_lookup is None
+                      else bool(cfg.fused_lookup))
         if want_fused and corr_state.impl in ("reg", "reg_pallas"):
             from raft_stereo_tpu.ops.pallas.lookup_kernels import (
                 fused_lookup_applicable)
             use_fused_lookup = fused_lookup_applicable(corr_state.levels,
                                                        cfg.corr_radius)
-        # Flow-branch kernel: auto currently resolves OFF (CPU-verified,
-        # TPU contribution unmeasured — config.py fused_flow).
-        use_fused_flow = False
-        if cfg.fused_flow:
-            from raft_stereo_tpu.ops.pallas.lookup_kernels import (
-                fused_flow_f1_applicable)
-            gh, gw = net_list[0].shape[1], net_list[0].shape[2]
-            use_fused_flow = fused_flow_f1_applicable(gh, gw)
 
         b, h, w, _ = net_list[0].shape
         coords0 = coords_grid(b, h, w)
@@ -446,9 +444,9 @@ class RAFTStereo(nn.Module):
             in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
             out_axes=0,
             length=iters,
+            unroll=cfg.scan_unroll,
         )(cfg, test_mode, fused, deferred, dt,
-          fused_lookup=use_fused_lookup, fused_flow=use_fused_flow,
-          name="refinement")
+          fused_lookup=use_fused_lookup, name="refinement")
         gt_and_mask = None
         if fused:
             gt_and_mask = (flow_gt.astype(jnp.float32),
@@ -485,15 +483,15 @@ class RAFTStereo(nn.Module):
                 nch = upsample_chunk_count(it, bb, hp, wp, cfg.factor,
                                            budget=cfg.upsample_tile_budget)
 
-                # Rematerialized: without the checkpoint, autodiff saves
-                # the upsample's fp32 softmax weights and tile products for
-                # EVERY chunk across the loss backward — measured 1.93 GB
-                # (+ 3x 220 MB tile buffers) at SceneFlow b8, the largest
-                # allocation in the step and the difference between fitting
-                # and not fitting 16 GB (r4 AOT breakdown). Recomputing the
-                # chunk from its (bf16, scan-output) slices costs one extra
-                # batched upsample — cheap, and only in the backward.
-                @jax.checkpoint
+                # Rematerialized (config.remat_loss_tail): without the
+                # checkpoint, autodiff saves the upsample's fp32 softmax
+                # weights and tile products for EVERY chunk across the loss
+                # backward — measured 1.93 GB (+ 3x 220 MB tile buffers) at
+                # SceneFlow b8, the largest allocation in the step and the
+                # difference between fitting and not fitting 16 GB (r4 AOT
+                # breakdown). Recomputing the chunk from its (bf16,
+                # scan-output) slices costs one extra batched upsample —
+                # only in the backward.
                 def chunk_err(args):
                     lr_c, mk_c = args  # (itc, B, h, w, ...)
                     itc = lr_c.shape[0]
@@ -506,6 +504,8 @@ class RAFTStereo(nn.Module):
                     e = jnp.where(mask_t[None] > 0, e, 0.0)
                     return jnp.sum(e, axis=(1, 2, 3, 4, 5))
 
+                if cfg.remat_loss_tail:
+                    chunk_err = jax.checkpoint(chunk_err)
                 if nch > 1:
                     itc = it // nch
                     err_sums = jax.lax.map(chunk_err, (
@@ -522,7 +522,6 @@ class RAFTStereo(nn.Module):
             # Rematerialized for the same reason as chunk_err above: the
             # stacked path's softmax/tile intermediates (~1.4 GB fp32 at b8)
             # otherwise persist across the whole loss backward.
-            @jax.checkpoint
             def upsample_stack(lr, mk):
                 tiles = convex_upsample_tiles(
                     lr.reshape(it * bb, hp, wp, 1).astype(jnp.float32),
@@ -531,6 +530,8 @@ class RAFTStereo(nn.Module):
                 up = upsample_tiles_to_image(tiles)
                 return up.reshape(it, bb, hp * cfg.factor, wp * cfg.factor, 1)
 
+            if cfg.remat_loss_tail:
+                upsample_stack = jax.checkpoint(upsample_stack)
             return upsample_stack(lowres, masks)
         if fused:
             return flow_predictions, carry[2]

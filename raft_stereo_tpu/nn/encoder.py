@@ -62,7 +62,11 @@ class _Trunk(nn.Module):
                 def apply_block(x):
                     b, h, w, c = x.shape
                     factor = 1
-                    if c % 128:  # already lane-sized saves gain nothing
+                    # Gated on fold_saves (config.fold_enc_saves): the fold
+                    # trades saved-bytes lane padding for relayout copies,
+                    # a win only when residual pressure is the binding
+                    # constraint (see fold_enc_saves_auto's calibration).
+                    if fs and c % 128:  # lane-sized saves gain nothing
                         for f in (2, 4):
                             if (c * f) % 128 == 0 and w % f == 0:
                                 factor = f

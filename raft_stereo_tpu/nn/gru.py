@@ -200,8 +200,7 @@ class BasicMotionEncoder(nn.Module):
     dtype: Optional[Dtype] = None
 
     @nn.compact
-    def __call__(self, flow, corr, corr_state=None, coords_x=None,
-                 fused_flow: bool = False):
+    def __call__(self, flow, corr, corr_state=None, coords_x=None):
         d = self.dtype
         if corr_state is not None:
             # Fused path: the 4-level pyramid lookup and convc1 (1x1) + ReLU
@@ -225,22 +224,12 @@ class BasicMotionEncoder(nn.Module):
         cor = nn.relu(checkpoint_name(
             Conv.make(64, 3, 1, 1, d, "convc2")(cor), "motion_c2"))
         kern, bias = _ConvParams((7, 7), 2, 64, name="convf1")()
-        if fused_flow:
-            # flow derived from detached coords in-kernel; only the
-            # x-column of convf1 participates (same exact-gradient argument
-            # as the unfused branch below)
-            from raft_stereo_tpu.ops.pallas.lookup_kernels import (
-                fused_flow_f1)
-            flo = fused_flow_f1(coords_x,
-                                kern[:, :, 0, :].reshape(49, 64), bias, d)
-            flo = checkpoint_name(flo, "motion_f1")
-        else:
-            dtc = d or flow.dtype
-            flo = jax.lax.conv_general_dilated(
-                flow[..., :1].astype(dtc), kern[..., :1, :].astype(dtc),
-                (1, 1), ((3, 3), (3, 3)),
-                dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias.astype(dtc)
-            flo = nn.relu(checkpoint_name(flo, "motion_f1"))
+        dtc = d or flow.dtype
+        flo = jax.lax.conv_general_dilated(
+            flow[..., :1].astype(dtc), kern[..., :1, :].astype(dtc),
+            (1, 1), ((3, 3), (3, 3)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias.astype(dtc)
+        flo = nn.relu(checkpoint_name(flo, "motion_f1"))
         flo = nn.relu(checkpoint_name(
             Conv.make(64, 3, 1, 1, d, "convf2")(flo), "motion_f2"))
         out = nn.relu(checkpoint_name(
@@ -271,8 +260,7 @@ class BasicMultiUpdateBlock(nn.Module):
     @nn.compact
     def __call__(self, net: Tuple, inp: Tuple, corr=None, flow=None, *,
                  iter08: bool = True, iter16: bool = True, iter32: bool = True,
-                 update: bool = True, corr_state=None, coords_x=None,
-                 fused_flow: bool = False):
+                 update: bool = True, corr_state=None, coords_x=None):
         cfg = self.cfg
         d = self.dtype
         hd = cfg.hidden_dims
@@ -290,8 +278,7 @@ class BasicMultiUpdateBlock(nn.Module):
                     net[1], *inp[1], pool2x(net[0]))
         if iter08:
             motion = BasicMotionEncoder(cfg, dtype=d, name="encoder")(
-                flow, corr, corr_state=corr_state, coords_x=coords_x,
-                fused_flow=fused_flow)
+                flow, corr, corr_state=corr_state, coords_x=coords_x)
             if cfg.n_gru_layers > 1:
                 net[0] = ConvGRU(hd[2], dtype=d, name="gru08")(
                     net[0], *inp[0], motion, interp_to(net[1], net[0]))

@@ -294,208 +294,3 @@ def _flc_bwd(radius, dt, res, g):
 
 fused_lookup_c1.defvjp(_flc_fwd, _flc_bwd)
 
-
-# ---------------------------------------------------------------- flow branch
-#
-# The motion encoder's flow branch starts with ``convf1`` — a 7x7 conv whose
-# input is the 1-channel epipolar flow (core/update.py:70 with the
-# structurally-zero y channel dropped; see nn/gru.py BasicMotionEncoder).
-# A 1-input-channel conv is the XLA graph's worst fusion (the weight-grad
-# fusion measured 2.7 TF/s) and its input is derived per-iteration from
-# detached coords. This kernel computes flow = coords_x - col IN KERNEL and
-# runs the 49-tap conv as rank-1 VPU multiply-adds on the flat slab — the
-# formulation the removed full-fusion kernel verified. The only halo needed
-# is +-3 rows of the (N, 1) coords slab, delivered as clamped neighbour
-# chunks (tiny, unlike the wide-tensor halos that exploded Mosaic compile
-# time). Gradient obligations: flow is a function of detached coords only,
-# so the kernel owes ONLY the convf1 weight/bias gradients.
-
-_F1_HALO_ROWS = 3
-
-
-def _cat3(a, b, c):
-    return jnp.concatenate([a[0], b[0], c[0]], axis=0)
-
-
-def _flow_slab(ca, cb, cc, j, hb, h, w):
-    """Flat 3-chunk coords slab -> (flow, rowmask, col); slab position p is
-    image (row, col) = ((j-1)*hb + p // w, p % w); beyond-edge chunks hold
-    clamped duplicates that the row mask zeroes."""
-    coords2 = _cat3(ca, cb, cc)                       # (3N, 1) f32
-    n = coords2.shape[0]
-    pid = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
-    rows = (j - 1) * hb + pid // w
-    col = pid % w
-    rowmask = ((rows >= 0) & (rows < h)).astype(jnp.float32)
-    flow = (coords2 - col.astype(jnp.float32)) * rowmask
-    return flow, rowmask, col
-
-
-def _flow_taps49(flow, w, col):
-    """The 49 shifted/masked ``(N, 1)`` taps of the 7x7 ``convf1`` on the
-    flattened 1-channel flow; tap ``(u, v)`` reads ``flow[r+u-3, c+v-3]``
-    (a sublane shift by ``(u-3)*w + (v-3)`` with a column-validity mask
-    restoring the conv's zero padding at row boundaries)."""
-    taps = []
-    for u in range(7):
-        for v in range(7):
-            off = (u - 3) * w + (v - 3)
-            if off == 0:
-                xs = flow
-            else:
-                z = jnp.zeros_like(flow[:abs(off)])
-                xs = (jnp.concatenate([flow[off:], z], 0) if off > 0
-                      else jnp.concatenate([z, flow[:off]], 0))
-            if v != 3:
-                ok = ((col + (v - 3) >= 0) & (col + (v - 3) < w))
-                xs = xs * ok.astype(xs.dtype)
-            taps.append(xs)
-    return taps
-
-
-def _ff1_fwd_kernel(hb, h, w, dt, *refs):
-    (ca, cb, cc, k_ref, b_ref, out_ref) = refs
-    j = pl.program_id(1)
-    flow, rowmask, col = _flow_slab(ca, cb, cc, j, hb, h, w)
-    taps = _flow_taps49(flow, w, col)
-    acc = None
-    for t, xs in enumerate(taps):
-        term = xs * k_ref[t][None, :]       # (3N,1)*(1,64) rank-1 broadcast
-        acc = term if acc is None else acc + term
-    pre = acc.astype(jnp.float32) + b_ref[0].astype(jnp.float32)
-    out = jax.nn.relu(pre) * rowmask
-    n = hb * w
-    out_ref[0] = out[n:2 * n].astype(dt)
-
-
-def _ff1_bwd_kernel(hb, h, w, dt, *refs):
-    (ca, cb, cc, g_ref, k_ref, b_ref, dk_ref, db_ref) = refs
-    i = pl.program_id(0)
-    j = pl.program_id(1)
-
-    @pl.when((i == 0) & (j == 0))
-    def _():
-        dk_ref[...] = jnp.zeros_like(dk_ref)
-        db_ref[...] = jnp.zeros_like(db_ref)
-
-    flow, rowmask, col = _flow_slab(ca, cb, cc, j, hb, h, w)
-    taps = _flow_taps49(flow, w, col)
-    acc = None
-    for t, xs in enumerate(taps):
-        term = xs * k_ref[t][None, :]
-        acc = term if acc is None else acc + term
-    pre = acc.astype(jnp.float32) + b_ref[0].astype(jnp.float32)
-    # cotangent arrives for the middle (owned) rows; pad to slab frame so
-    # the taps (computed on the slab) align, then mask to interior
-    n = hb * w
-    g_mid = g_ref[0].astype(jnp.float32)
-    zeros = jnp.zeros_like(g_mid)
-    g = jnp.concatenate([zeros, g_mid, zeros], axis=0)
-    g = g * (pre > 0) * rowmask
-    for t, xs in enumerate(taps):
-        dk_ref[t, :] += jnp.sum(xs * g, axis=0)
-    db_ref[0] += jnp.sum(g, axis=0)
-
-
-def fused_flow_f1_applicable(h: int, w: int) -> bool:
-    """Static check: a row block divides h with the halo inside it, and the
-    3-chunk tap slabs (~3 live fp32 (3*hb*w, 64) tensors during the 49-tap
-    accumulation) fit the VMEM budget at very wide grids."""
-    hb = _pick_f1_hb(h)
-    if hb <= _F1_HALO_ROWS:
-        return False
-    return 3 * (3 * hb * w) * 64 * 4 <= _VMEM_BUDGET
-
-
-def _pick_f1_hb(h: int) -> int:
-    for hb in (16, 8, 4):
-        if h % hb == 0:
-            return hb
-    return 0
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_flow_f1(coords_x: jax.Array, kernel: jax.Array, bias: jax.Array,
-                  dt) -> jax.Array:
-    """``relu(conv7x7(flow) + bias)`` with ``flow = coords_x - col`` computed
-    in-kernel from the detached lookup centers.
-
-    Args:
-      coords_x: ``(B, H, W)`` fp32 lookup centers (detached by the caller —
-        the kernel returns a zero coords cotangent, matching the unfused
-        graph where flow has no gradient path).
-      kernel: ``(49, 64)`` fp32 — ``convf1``'s x-channel, taps flattened
-        row-major (tap (u, v) at index ``u*7 + v``).
-      bias: ``(64,)`` fp32.
-
-    Returns:
-      ``(B, H, W, 64)`` in ``dt`` — the motion encoder's ``flo1`` activation.
-    """
-    return _ff1_fwd(coords_x, kernel, bias, dt)[0]
-
-
-def _ff1_fwd(coords_x, kernel, bias, dt):
-    dt = jnp.dtype(dt) if dt is not None else jnp.float32
-    b, h, w = coords_x.shape
-    hb = _pick_f1_hb(h)
-    if hb <= _F1_HALO_ROWS:
-        raise ValueError("fused_flow_f1: gate on fused_flow_f1_applicable()")
-    nb = h // hb
-    co = kernel.shape[-1]
-    coords_f = coords_x.astype(jnp.float32).reshape(b, h * w, 1)
-    bias2 = bias.reshape(1, co)
-
-    def halo(k):
-        return pl.BlockSpec((1, hb * w, 1),
-                            lambda i, j, kk=k: (i, jnp.clip(j + kk, 0,
-                                                            nb - 1), 0))
-
-    out = pl.pallas_call(
-        functools.partial(_ff1_fwd_kernel, hb, h, w, dt),
-        grid=(b, nb),
-        in_specs=[halo(-1), halo(0), halo(1)]
-        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
-        out_specs=pl.BlockSpec((1, hb * w, co), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h * w, co), dt),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
-        interpret=_interpret(),
-    )(coords_f, coords_f, coords_f, kernel, bias2)
-    return out.reshape(b, h, w, co), (coords_x, kernel, bias)
-
-
-def _ff1_bwd(dt, res, g):
-    dt = jnp.dtype(dt) if dt is not None else jnp.float32
-    coords_x, kernel, bias = res
-    b, h, w = coords_x.shape
-    hb = _pick_f1_hb(h)
-    nb = h // hb
-    co = kernel.shape[-1]
-    coords_f = coords_x.astype(jnp.float32).reshape(b, h * w, 1)
-    g_f = g.astype(dt).reshape(b, h * w, co)
-    bias2 = bias.reshape(1, co)
-
-    def halo(k):
-        return pl.BlockSpec((1, hb * w, 1),
-                            lambda i, j, kk=k: (i, jnp.clip(j + kk, 0,
-                                                            nb - 1), 0))
-
-    whole = lambda shp: pl.BlockSpec(shp, lambda i, j: (0,) * len(shp))
-    dk, db = pl.pallas_call(
-        functools.partial(_ff1_bwd_kernel, hb, h, w, dt),
-        grid=(b, nb),
-        in_specs=[halo(-1), halo(0), halo(1),
-                  pl.BlockSpec((1, hb * w, co), lambda i, j: (i, j, 0))]
-        + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
-        out_specs=[whole(kernel.shape), whole((1, co))],
-        out_shape=[jax.ShapeDtypeStruct(kernel.shape, jnp.float32),
-                   jax.ShapeDtypeStruct((1, co), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
-        interpret=_interpret(),
-    )(coords_f, coords_f, coords_f, g_f, kernel, bias2)
-    return (jnp.zeros_like(coords_x), dk.astype(kernel.dtype),
-            db.reshape(co).astype(bias.dtype))
-
-
-fused_flow_f1.defvjp(_ff1_fwd, _ff1_bwd)

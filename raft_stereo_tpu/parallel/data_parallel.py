@@ -74,18 +74,16 @@ def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
     """
     import dataclasses
 
-    if (getattr(model.cfg, "fused_lookup", False) is not False
-            or getattr(model.cfg, "fused_flow", False) is not False):
-        # The fused Pallas kernels (lookup+convc1, flow-branch convf1) have
-        # no SPMD partitioning rule: under auto-SPMD they would force their
-        # operands replicated (gathering the full volume / coords onto
-        # every device). The explicit shard_map DP path sees per-shard
-        # shapes and keeps the kernels; this path forces the unfused
-        # (identical-semantics) graph — also overriding the
-        # auto(None)-resolves-ON TPU default.
+    if getattr(model.cfg, "fused_lookup", None):
+        # The fused lookup+convc1 Pallas kernel has no SPMD partitioning
+        # rule: under auto-SPMD it would force its operands replicated
+        # (gathering the full volume onto every device). The explicit
+        # shard_map DP path sees per-shard shapes and keeps the kernel;
+        # this path forces the unfused (identical-semantics) graph even
+        # when a user opted in explicitly (auto/None already resolves OFF
+        # since the r4 A/B — config.py).
         model = model.clone(
-            cfg=dataclasses.replace(model.cfg, fused_lookup=False,
-                                    fused_flow=False))
+            cfg=dataclasses.replace(model.cfg, fused_lookup=False))
     step = make_train_step(model, tx, train_iters, axis_name=None,
                            fused_loss=fused_loss)
     state_sharding = replicated(mesh)

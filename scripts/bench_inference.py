@@ -30,8 +30,6 @@ def main():
     parser.add_argument("--frames", type=int, default=12)
     parser.add_argument("--fused_lookup", choices=["auto", "on", "off"],
                         default="auto")
-    parser.add_argument("--fused_flow", choices=["auto", "on", "off"],
-                        default="auto")
     args = parser.parse_args()
 
     import jax
@@ -46,9 +44,8 @@ def main():
     }
     tri = {"auto": None, "on": True, "off": False}
     import dataclasses
-    presets = {k: (dataclasses.replace(c, fused_lookup=tri[args.fused_lookup],
-                                       fused_flow=tri[args.fused_flow]), it)
-               for k, (c, it) in presets.items()}
+    presets = {k: (dataclasses.replace(c, fused_lookup=tri[args.fused_lookup]),
+                   it) for k, (c, it) in presets.items()}
     chosen = ["default", "realtime"] if args.preset == "both" else [args.preset]
 
     h, w = args.size

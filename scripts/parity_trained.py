@@ -170,11 +170,11 @@ def main():
         "fp32+alt_pallas": create_model(RAFTStereoConfig(
             corr_implementation="alt_pallas",
             corr_storage_dtype="float32")),
-        # r4 fused kernels: 4-level pyramid lookup + convc1 in one Pallas
-        # kernel (fused_lookup) and the flow-branch convf1 kernel
-        # (fused_flow) — the default/experimental TPU hot path.
+        # r4 fused kernel: 4-level pyramid lookup + convc1 in one Pallas
+        # kernel (fused_lookup) — opt-in (measured slower than XLA's
+        # unfused path, PERF.md r4 A/B; parity still pinned here).
         "fp32+fused_r4": create_model(RAFTStereoConfig(
-            fused_lookup=True, fused_flow=True)),
+            fused_lookup=True)),
     }
     variants = {
         **gated,
@@ -294,7 +294,7 @@ def realtime_parity(args, make_pair, epe):
             **base, corr_implementation="reg_pallas",
             corr_storage_dtype="float32")),
         "rt-fp32+fused_r4": create_model(RAFTStereoConfig(
-            **base, fused_lookup=True, fused_flow=True)),
+            **base, fused_lookup=True)),
     }
     variants = {
         **gated,

@@ -72,14 +72,20 @@ def main():
     p.add_argument("--corr", default="reg")
     p.add_argument("--top", type=int, default=14)
     p.add_argument("--logdir", default="/tmp/profile_step")
+    p.add_argument("--best_schedule", action="store_true",
+                   help="the r4-measured best schedule: one-shot post-scan "
+                        "upsample + saved loss tail + unfolded saves "
+                        "(bench.py banker)")
     args = p.parse_args()
 
     remat_enc = {"False": False, "True": True}.get(
         str(args.remat_encoders), args.remat_encoders)
+    sched = (dict(upsample_tile_budget=2_147_483_648, remat_loss_tail=False,
+                  fold_enc_saves=False) if args.best_schedule else {})
     cfg = RAFTStereoConfig(mixed_precision=True,
                            corr_storage_dtype="bfloat16",
                            corr_implementation=args.corr,
-                           remat_encoders=remat_enc)
+                           remat_encoders=remat_enc, **sched)
     tcfg = TrainConfig(batch_size=args.batch, train_iters=args.iters,
                        num_steps=200000, image_size=(args.h, args.w))
     model, variables = init_model(jax.random.PRNGKey(0), cfg,

@@ -138,13 +138,21 @@ def test_deadline_stops_chain_but_keeps_best():
 
 
 def test_real_chain_shape():
-    """The production TPU chain: primary first with a tight timeout, a
-    banker, a below-par control, experiments, then fallbacks."""
+    """The production TPU chain: primary first with a tight timeout, the
+    below-par-gated banker second (it must run even when a slow primary
+    banked a number), then unbanked fallbacks only."""
     chain = bench._attempt_chain(True)
     assert chain[0]["when"] == "always" and chain[0]["timeout_s"]
-    whens = [a["when"] for a in chain]
-    assert "unbanked" in whens and "below_par" in whens
-    assert whens.count("always") >= 3  # primary + experiments
+    assert chain[1]["when"] == "below_par"
+    assert chain[1]["kw"]["remat_encoders"] == "blocks"
+    # the r4-measured best schedule is on both the primary and the banker
+    for att in chain[:2]:
+        assert att["kw"]["remat_loss_tail"] is False
+        assert att["kw"]["fold_enc_saves"] is False
+        assert att["kw"]["upsample_budget"] > 10 ** 9
+    assert all(a["when"] == "unbanked" for a in chain[2:])
+    # the split-step attempt is gone (helper-rejected at b8 in r3 AND r4)
+    assert not any(a["kw"].get("split_step") for a in chain)
     # every attempt is the SceneFlow recipe family
     for a in chain:
         assert a["kw"]["train_iters"] == 22

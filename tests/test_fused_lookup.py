@@ -133,67 +133,6 @@ def test_multiblock_grid_forward_and_gradients():
                                atol=1e-3, err_msg="d_bias (multiblock)")
 
 
-# ---- flow-branch kernel (fused_flow_f1) ----
-
-
-def _flow_reference(coords, kern, bias):
-    w = coords.shape[-1]
-    col = jnp.arange(w, dtype=jnp.float32)[None, None, :]
-    flow = (coords - col)[..., None]
-    k4 = kern.reshape(7, 7, 1, 64)
-    y = jax.lax.conv_general_dilated(
-        flow, k4, (1, 1), ((3, 3), (3, 3)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
-    return jax.nn.relu(y)
-
-
-def test_flow_f1_forward_and_gradients():
-    """fused_flow_f1 vs the XLA composition (conv7x7 on coords-derived
-    flow): forward, weight/bias grads, and the structurally-zero coords
-    cotangent. h=32 -> hb=16 -> a 2-row-block grid, covering the clamped
-    halo chunks at both image edges."""
-    from raft_stereo_tpu.ops.pallas.lookup_kernels import (
-        fused_flow_f1,
-        fused_flow_f1_applicable,
-    )
-
-    rng = np.random.default_rng(3)
-    b, h, w = 2, 32, 48
-    assert fused_flow_f1_applicable(h, w)
-    coords = jnp.asarray(rng.uniform(-5, w + 5, (b, h, w)), jnp.float32)
-    kern = jnp.asarray(rng.normal(size=(49, 64)) * 0.2, jnp.float32)
-    bias = jnp.asarray(rng.normal(size=(64,)) * 0.2, jnp.float32)
-
-    out = fused_flow_f1(coords, kern, bias, None)
-    ref = _flow_reference(coords, kern, bias)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
-
-    ct = jnp.asarray(rng.normal(size=ref.shape), jnp.float32)
-    gf = jax.grad(lambda c, k, bb: jnp.sum(fused_flow_f1(c, k, bb, None) * ct),
-                  argnums=(0, 1, 2))(coords, kern, bias)
-    gr = jax.grad(lambda c, k, bb: jnp.sum(_flow_reference(c, k, bb) * ct),
-                  argnums=(1, 2))(coords, kern, bias)
-    assert float(jnp.max(jnp.abs(gf[0]))) == 0.0
-    np.testing.assert_allclose(np.asarray(gf[1]), np.asarray(gr[0]),
-                               atol=5e-3, err_msg="d_kernel")
-    np.testing.assert_allclose(np.asarray(gf[2]), np.asarray(gr[1]),
-                               atol=1e-3, err_msg="d_bias")
-
-
-def test_model_forward_fused_flow_vs_unfused():
-    """fused_flow=True through the full model is the unfused graph's math."""
-    cfg_off = RAFTStereoConfig(fused_lookup=False, fused_flow=False)
-    cfg_on = RAFTStereoConfig(fused_lookup=False, fused_flow=True)
-    model_off, variables = init_model(jax.random.PRNGKey(0), cfg_off,
-                                      (1, H, W, 3))
-    model_on = create_model(cfg_on)
-    i1, i2 = make_images(seed=9)
-    out_off = model_off.apply(variables, i1, i2, iters=ITERS)
-    out_on = model_on.apply(variables, i1, i2, iters=ITERS)
-    np.testing.assert_allclose(np.asarray(out_on, np.float32),
-                               np.asarray(out_off, np.float32), atol=5e-3)
-
-
 # ---- end-to-end model equivalence (shape where the kernel engages) ----
 
 H, W = 32, 352  # 1/4-res grid 8x88; pyramid W2s (88, 44, 22, 11)

@@ -336,9 +336,10 @@ def test_encoder_remat_variants_identical():
     want_g = jax.grad(loss(model0))(variables["params"])
     for variant in (True, "blocks", "norms"):
         kwargs = {"remat_encoders": variant}
-        if variant == "norms":
+        if variant in ("norms", "blocks"):
             # also exercise the lane-dense folded saves (auto rule keeps
-            # them off at test shapes)
+            # them off at test shapes); for "blocks" the fold wraps the
+            # remat boundary itself (encoder.py apply_block)
             kwargs["fold_enc_saves"] = True
         m = create_model(RAFTStereoConfig(**kwargs))
         got_out = m.apply(variables, img1, img2, iters=2)
