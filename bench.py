@@ -45,7 +45,7 @@ _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "4800"))
 
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               remat_encoders=False, split_step=False, fused_lookup=None,
-              upsample_budget=None, remat_loss_tail=True,
+              upsample_tile_budget=None, remat_loss_tail=True,
               fold_enc_saves=None, scan_unroll=1):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
@@ -73,7 +73,7 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
                            corr_storage_dtype="bfloat16",
                            remat_encoders=remat_encoders,
                            fused_lookup=fused_lookup,
-                           upsample_tile_budget=upsample_budget,
+                           upsample_tile_budget=upsample_tile_budget,
                            remat_loss_tail=remat_loss_tail,
                            fold_enc_saves=fold_enc_saves,
                            scan_unroll=scan_unroll)
@@ -175,9 +175,11 @@ def _attempt_chain(on_tpu):
     # loss tail (the rematerialized tail's backward recompute cost -0.2;
     # its residency fits b8 alongside UNFOLDED blocks-remat saves, whose
     # lane-dense fold cost -0.39). fused_lookup auto already resolves OFF
-    # (-1.5, PERF.md "r4 A/B").
-    best_sched = dict(upsample_budget=2_147_483_648, remat_loss_tail=False,
-                      fold_enc_saves=False)
+    # (-1.5, PERF.md "r4 A/B"). Shared with scripts/profile_step.py via
+    # config.R4_BEST_SCHEDULE (keys = RAFTStereoConfig field names = the
+    # run_bench kwarg names) so the profiled schedule tracks the banker.
+    from raft_stereo_tpu.config import R4_BEST_SCHEDULE
+    best_sched = dict(R4_BEST_SCHEDULE)
     return [
         # Primary: monolithic deferred-upsample + fused-loss b8 — the fastest
         # variant IF the compile service accepts it (it has rejected every

@@ -86,8 +86,11 @@ class RAFTStereoConfig:
     # Under remat_encoders="norms"/"blocks": save conv outputs ("norms") or
     # remat-boundary block inputs ("blocks") in a lane-dense folded shape
     # (64/96-channel saves are otherwise padded 2x/1.33x to the 128-lane
-    # tile). None = auto by estimated padded size (folds at the SceneFlow
-    # b8 shape, not at b4); bool forces.
+    # tile). None = auto, policy per remat mode: "norms" folds by estimated
+    # padded size (its padded save set genuinely cannot fit a 16 GB chip at
+    # SceneFlow b8 — fold_enc_saves_auto), "blocks" stays UNFOLDED (its
+    # padded saves fit there, and the fold's relayout copies measured
+    # -0.39 pairs/s — PERF.md r4 A/B). bool forces either way.
     fold_enc_saves: Optional[bool] = None
     # Ours: fp32 working-set budget (bytes) for the post-scan batched
     # upsample before it is chunked over the iteration axis (lax.map
@@ -227,3 +230,15 @@ def middlebury_finetune_config() -> tuple[RAFTStereoConfig, TrainConfig]:
                     spatial_scale=(-0.2, 0.4), saturation_range=(0.0, 1.4),
                     restore_ckpt="models/raftstereo-sceneflow.pth"),
     )
+
+
+# The r4-measured fastest SceneFlow-b8 training schedule (9.42 pairs/s/chip,
+# PERF.md "r4 A/B"): one-shot post-scan upsample, saved (not rematerialized)
+# loss tail, unfolded blocks-remat saves. Keyed by RAFTStereoConfig field
+# names; shared by bench.py's banker and scripts/profile_step.py so the
+# profiled schedule can never silently drift from the benched one.
+R4_BEST_SCHEDULE = {
+    "upsample_tile_budget": 2_147_483_648,
+    "remat_loss_tail": False,
+    "fold_enc_saves": False,
+}

@@ -80,8 +80,8 @@ def main():
 
     remat_enc = {"False": False, "True": True}.get(
         str(args.remat_encoders), args.remat_encoders)
-    sched = (dict(upsample_tile_budget=2_147_483_648, remat_loss_tail=False,
-                  fold_enc_saves=False) if args.best_schedule else {})
+    from raft_stereo_tpu.config import R4_BEST_SCHEDULE
+    sched = dict(R4_BEST_SCHEDULE) if args.best_schedule else {}
     cfg = RAFTStereoConfig(mixed_precision=True,
                            corr_storage_dtype="bfloat16",
                            corr_implementation=args.corr,

@@ -149,7 +149,7 @@ def test_real_chain_shape():
     for att in chain[:2]:
         assert att["kw"]["remat_loss_tail"] is False
         assert att["kw"]["fold_enc_saves"] is False
-        assert att["kw"]["upsample_budget"] > 10 ** 9
+        assert att["kw"]["upsample_tile_budget"] > 10 ** 9
     assert all(a["when"] == "unbanked" for a in chain[2:])
     # the split-step attempt is gone (helper-rejected at b8 in r3 AND r4)
     assert not any(a["kw"].get("split_step") for a in chain)

@@ -352,6 +352,98 @@ def test_encoder_remat_variants_identical():
                                        atol=1e-6, err_msg=str(variant))
 
 
+def test_schedule_knobs_identical_train_step():
+    """remat_loss_tail and scan_unroll are pure scheduling: the fused-loss
+    forward and the parameter gradients must match across settings (up to
+    XLA fusion-level float reassociation — params-after-AdamW are NOT
+    compared because Adam normalizes reassociation-dust gradients into
+    lr-sized update differences). These are the knobs the r4 bench banker
+    flips (bench.py)."""
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import create_model, init_model
+
+    base = RAFTStereoConfig()
+    model0, variables = init_model(jax.random.PRNGKey(0), base, (1, 32, 64, 3))
+    rng = np.random.default_rng(7)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 64, 3)), jnp.float32)
+    gt = jnp.asarray(rng.uniform(-16, 0, (1, 32, 64, 1)), jnp.float32)
+    mask = jnp.ones((1, 32, 64, 1), jnp.float32)
+    rest = {k: v for k, v in variables.items() if k != "params"}
+
+    def l1_loss(model):
+        def f(p):
+            err, _ = model.apply({"params": p, **rest}, img1, img2, iters=2,
+                                 flow_gt=gt, loss_mask=mask)
+            return jnp.sum(err)
+        return f
+
+    def smooth_loss(model):
+        # mean-of-squares over the prediction stack: the L1 objective's
+        # sign() backward is discontinuous, so ulp-level forward changes
+        # (which unroll's refusioning legitimately makes) flip cotangents
+        # on near-zero elements; a smooth loss isolates scheduling bugs
+        # from that amplification.
+        def f(p):
+            out = model.apply({"params": p, **rest}, img1, img2, iters=2)
+            return jnp.mean(jnp.square(out))
+        return f
+
+    # remat_loss_tail flips only the save/recompute schedule of the loss
+    # tail — same fusion decisions elsewhere, so L1 grads match tightly.
+    want = l1_loss(model0)(variables["params"])
+    want_g = jax.grad(l1_loss(model0))(variables["params"])
+    m_tail = create_model(RAFTStereoConfig(remat_loss_tail=False))
+    np.testing.assert_allclose(
+        np.asarray(l1_loss(m_tail)(variables["params"])), np.asarray(want),
+        rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(want_g),
+                    jax.tree_util.tree_leaves(
+                        jax.grad(l1_loss(m_tail))(variables["params"]))):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg="remat_loss_tail")
+
+    # scan_unroll: forward pinned tightly on BOTH losses; grads on the
+    # smooth loss (see smooth_loss's comment).
+    m_unroll = create_model(RAFTStereoConfig(scan_unroll=2))
+    np.testing.assert_allclose(
+        np.asarray(l1_loss(m_unroll)(variables["params"])), np.asarray(want),
+        rtol=1e-6)
+    want_s = smooth_loss(model0)(variables["params"])
+    want_sg = jax.grad(smooth_loss(model0))(variables["params"])
+    np.testing.assert_allclose(
+        np.asarray(smooth_loss(m_unroll)(variables["params"])),
+        np.asarray(want_s), rtol=1e-6)
+    got_sg = jax.grad(smooth_loss(m_unroll))(variables["params"])
+    want_leaves = [np.asarray(x, np.float64)
+                   for x in jax.tree_util.tree_leaves(want_sg)]
+    got_leaves = [np.asarray(x, np.float64)
+                  for x in jax.tree_util.tree_leaves(got_sg)]
+    global_scale = max(np.linalg.norm(a) for a in want_leaves)
+    for a, b in zip(want_leaves, got_leaves):
+        # Relative-L2 per leaf: unroll's refusioning reorders fp32
+        # accumulations throughout the backward, moving scattered
+        # cancellation-prone elements by up to ~0.1% of leaf scale —
+        # elementwise bounds chase that tail one outlier at a time, while
+        # an aggregate 0.1% L2 bound pins the semantics (a scheduling bug
+        # like a dropped iteration shows up at O(10-100%), not 0.1%).
+        # Leaves that are pure float residue get an absolute bound: a conv
+        # bias feeding instance norm has a structurally-ZERO gradient
+        # (the norm subtracts any bias shift), so its computed value is
+        # reassociation noise with O(1) relative spread across schedules.
+        diff = np.linalg.norm(b - a)
+        na = np.linalg.norm(a)
+        if na < 1e-6 * global_scale:
+            assert diff < 1e-6 * global_scale, \
+                f"scan_unroll: residual leaf moved {diff:.2e}"
+        else:
+            rel = diff / na
+            assert rel < 1e-3, f"scan_unroll: leaf rel-L2 {rel:.2e}"
+
+
 def test_grad_accumulation_updates_every_k():
     """optax.MultiSteps wiring: params move only on each k-th micro-step."""
     import jax
