@@ -134,8 +134,11 @@ class RefinementStep(nn.Module):
       the (B, H/f, W/f, 9*f^2) fp32 mask cost ~1.5 GB of residuals).
     * train fused-loss: ``(net, coords1, flow_up)`` — the final full-res
       prediction rides the carry (needed after the scan for metrics).
-    * test: ``(net, coords1, mask)`` — the final mask feeds the one
-      deferred upsample (raft_stereo.py:126-136); no backward pass exists.
+    * test: ``(net, coords1)`` — only the FINAL iteration computes the
+      upsample mask (compute_mask=True, run unscanned on shared params);
+      the scanned iterations skip the mask head and carry no mask slot
+      (raft_stereo.py:126-136 uses one deferred upsample; the reference
+      computes-and-discards the other iterations' masks).
     """
 
     cfg: RAFTStereoConfig
@@ -147,7 +150,7 @@ class RefinementStep(nn.Module):
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
-                 gt_and_mask):
+                 gt_and_mask, compute_mask: bool = True):
         net, coords1 = carry[0], carry[1]
         coords1 = jax.lax.stop_gradient(coords1)
 
@@ -175,7 +178,8 @@ class RefinementStep(nn.Module):
             net, inp_list, corr, flow.astype(dt) if dt else flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
             corr_state=corr_state if self.fused_lookup else None,
-            coords_x=coords1[..., 0] if self.fused_lookup else None)
+            coords_x=coords1[..., 0] if self.fused_lookup else None,
+            compute_mask=compute_mask)
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
@@ -183,8 +187,10 @@ class RefinementStep(nn.Module):
         coords1 = coords1 + delta_flow
 
         if self.test_mode:
-            # intermediate upsampling skipped (raft_stereo.py:126-127)
-            return (net, coords1, mask.astype(jnp.float32)), None
+            # intermediate upsampling skipped (raft_stereo.py:126-127); the
+            # mask exists only on the final (compute_mask=True) iteration
+            return (net, coords1), (mask.astype(jnp.float32)
+                                    if compute_mask else None)
         if self.deferred:
             # deferred-upsample: emit the low-res flow and (compute-dtype)
             # mask; one batched upsample runs after the scan (and, in the
@@ -344,6 +350,11 @@ class RAFTStereo(nn.Module):
         """Post-encoder forward: context processing, correlation pyramid, the
         refinement scan, and the upsample/loss tail. Called from the compact
         ``__call__`` (both the monolithic and staged paths)."""
+        if iters < 1:
+            # The reference crashes on iters=0 too (its post-loop upsample
+            # reads the in-loop mask); make the contract explicit rather
+            # than returning an unrefined or once-refined field.
+            raise ValueError(f"iters must be >= 1, got {iters}")
         cfg = self.cfg
         dt = self.compute_dtype
 
@@ -407,10 +418,41 @@ class RAFTStereo(nn.Module):
                              "loss_mask (see training.loss.loss_mask)")
         deferred = cfg.deferred_upsample and not test_mode
         if test_mode:
-            mask_ch = 9 * cfg.factor ** 2
-            carry = (tuple(net_list), coords1,
-                     jnp.zeros((b, h, w, mask_ch), jnp.float32))
-        elif fused and not deferred:
+            # Inference scan: only the FINAL iteration's upsample mask is
+            # consumed (one deferred upsample, raft_stereo.py:126-136; the
+            # reference computes and discards the other iterations' masks).
+            # The first iters-1 iterations run as a lifted scan over a body
+            # with compute_mask=False — a STATIC flag, so the two mask-head
+            # convs are absent from the scanned graph — and the final
+            # iteration runs unscanned on the SAME module instance (shared
+            # params) with the mask head on. No backward exists, so no
+            # remat wrapper. Measured: default-preset KITTI-res inference
+            # 7.39 -> see PERF.md r4.
+            refine = RefinementStep(cfg, True, False, False, dt,
+                                    fused_lookup=use_fused_lookup,
+                                    name="refinement")
+            carry = (tuple(net_list), coords1)
+
+            def scan_iter(mdl, c, _):
+                c, _unused = mdl(c, corr_state, tuple(inp_list), coords0,
+                                 None, compute_mask=False)
+                return c, None
+
+            if iters > 1:
+                carry, _ = nn.scan(
+                    scan_iter,
+                    variable_broadcast="params",
+                    split_rngs={"params": False},
+                    length=iters - 1,
+                    unroll=cfg.scan_unroll,
+                )(refine, carry, None)
+            carry, mask = refine(carry, corr_state, tuple(inp_list), coords0,
+                                 None)
+            coords1 = carry[1]
+            flow_up = upsample_disparity_convex(coords1 - coords0, mask,
+                                                cfg.factor)
+            return coords1 - coords0, flow_up
+        if fused and not deferred:
             carry = (tuple(net_list), coords1,
                      jnp.zeros((b, h * cfg.factor, w * cfg.factor, 1),
                                jnp.float32))
@@ -454,11 +496,6 @@ class RAFTStereo(nn.Module):
         carry, flow_predictions = step(carry, corr_state, tuple(inp_list),
                                        coords0, gt_and_mask)
 
-        if test_mode:
-            net_list, coords1, mask = carry
-            flow_up = upsample_disparity_convex(coords1 - coords0, mask,
-                                                cfg.factor)
-            return coords1 - coords0, flow_up
         if deferred:
             lowres, masks = flow_predictions  # (it,B,h,w,1), (it,B,h,w,9f^2)
             it, bb, hp, wp = lowres.shape[:4]

@@ -260,7 +260,8 @@ class BasicMultiUpdateBlock(nn.Module):
     @nn.compact
     def __call__(self, net: Tuple, inp: Tuple, corr=None, flow=None, *,
                  iter08: bool = True, iter16: bool = True, iter32: bool = True,
-                 update: bool = True, corr_state=None, coords_x=None):
+                 update: bool = True, corr_state=None, coords_x=None,
+                 compute_mask: bool = True):
         cfg = self.cfg
         d = self.dtype
         hd = cfg.hidden_dims
@@ -292,6 +293,11 @@ class BasicMultiUpdateBlock(nn.Module):
         delta_flow = FlowHead(256, 2, epipolar=True, dtype=d,
                               name="flow_head")(net[0])
 
+        # compute_mask=False (static) drops the mask head from the graph:
+        # inference consumes only the FINAL iteration's mask, so the scanned
+        # iterations skip these two convs entirely (models/raft_stereo.py).
+        if not compute_mask:
+            return tuple(net), None, delta_flow
         # scale mask to balance gradients (update.py:136-137)
         mask = checkpoint_name(
             Conv.make(256, 3, 1, 1, d, "mask_conv1")(net[0]), "mask_hidden")

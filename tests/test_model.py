@@ -38,8 +38,12 @@ class TestForward:
         img1, img2 = _images(np.random.default_rng(1))
         preds = model.apply(variables, img1, img2, iters=3)
         low, up = model.apply(variables, img1, img2, iters=3, test_mode=True)
+        # rtol: test_mode runs the final iteration OUTSIDE the scan (the
+        # mask-head skip restructure) — different fusion boundaries than the
+        # train scan give ulp-level drift that compounds to ~6e-5 relative
+        # over the iterations; an iteration-count bug would show up at O(1).
         np.testing.assert_allclose(np.asarray(preds[-1]), np.asarray(up),
-                                   rtol=1e-5, atol=1e-5)
+                                   rtol=2e-4, atol=1e-4)
         assert low.shape == (1, 8, 16, 2)
 
     def test_iterations_refine(self, default_model):
