@@ -46,7 +46,8 @@ _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "4800"))
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               remat_encoders=False, split_step=False, fused_lookup=None,
               upsample_tile_budget=None, remat_loss_tail=True,
-              fold_enc_saves=None, scan_unroll=1):
+              fold_enc_saves=None, scan_unroll=1,
+              refinement_save_policy=None):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
     # degraded windows (r3: every big graph rejected; r4: wedged for hours);
@@ -76,7 +77,8 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
                            upsample_tile_budget=upsample_tile_budget,
                            remat_loss_tail=remat_loss_tail,
                            fold_enc_saves=fold_enc_saves,
-                           scan_unroll=scan_unroll)
+                           scan_unroll=scan_unroll,
+                           refinement_save_policy=refinement_save_policy)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 

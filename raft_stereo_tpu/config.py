@@ -108,6 +108,13 @@ class RAFTStereoConfig:
     # batched upsample in the backward, for shapes/chips where the
     # residency fits. Applies to both the chunked and stacked tails.
     remat_loss_tail: bool = True
+    # Ours: selective refinement-backward saves (keep gru_zr/gru_q/
+    # corr_feats across the scan backward instead of full per-iteration
+    # remat). None = auto by the measured-size estimate
+    # (models/raft_stereo.py refinement_save_policy_fits: ON at b4-like
+    # residency, OFF at b8 where HBM pressure inverted the trade in r2).
+    # bool forces either way — the A/B override the bench chain uses.
+    refinement_save_policy: Optional[bool] = None
     # Ours: lax.scan unroll factor for the refinement loop. >1 replicates
     # the iteration body inside the while loop, amortizing per-iteration
     # dispatch overhead and letting XLA fuse across consecutive iterations

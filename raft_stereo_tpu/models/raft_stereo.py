@@ -469,8 +469,13 @@ class RAFTStereo(nn.Module):
             # lookup output across the backward pass, recompute the rest —
             # but only while the saved residuals fit comfortably (see
             # refinement_save_policy_fits for the measurements).
-            if refinement_save_policy_fits(cfg, iters, b, h, w, dt,
-                                           fused_lookup=use_fused_lookup):
+            # config.refinement_save_policy overrides the auto estimate.
+            engage = (cfg.refinement_save_policy
+                      if cfg.refinement_save_policy is not None else
+                      refinement_save_policy_fits(
+                          cfg, iters, b, h, w, dt,
+                          fused_lookup=use_fused_lookup))
+            if engage:
                 body = nn.remat(
                     RefinementStep, prevent_cse=False,
                     policy=jax.checkpoint_policies.save_only_these_names(
