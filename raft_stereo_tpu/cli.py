@@ -53,6 +53,12 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                         "off — measured slower than XLA's unfused path on "
                         "every surface, PERF.md r4 A/B; 'on' opts in where "
                         "shapes fit)")
+    g.add_argument("--refinement_save_policy",
+                   choices=["auto", "on", "off"], default="auto",
+                   help="selective refinement-backward saves vs full remat "
+                        "(auto: by the measured-size estimate — ON at "
+                        "b4-like residency, OFF at b8 where HBM pressure "
+                        "inverts the trade; PERF.md)")
     g.add_argument("--no_remat_loss_tail", action="store_true",
                    help="save the post-scan upsample/loss intermediates "
                         "across the loss backward instead of recomputing "
@@ -77,6 +83,8 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         fused_lookup={"auto": None, "on": True, "off": False}[
             getattr(args, "fused_lookup", "auto")],
         remat_loss_tail=not getattr(args, "no_remat_loss_tail", False),
+        refinement_save_policy={"auto": None, "on": True, "off": False}[
+            getattr(args, "refinement_save_policy", "auto")],
     )
 
 
