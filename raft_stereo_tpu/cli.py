@@ -54,11 +54,12 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                         "every surface, PERF.md r4 A/B; 'on' opts in where "
                         "shapes fit)")
     g.add_argument("--refinement_save_policy",
-                   choices=["auto", "on", "off"], default="auto",
+                   choices=["auto", "on", "off", "corr"], default="auto",
                    help="selective refinement-backward saves vs full remat "
                         "(auto: by the measured-size estimate — ON at "
                         "b4-like residency, OFF at b8 where HBM pressure "
-                        "inverts the trade; PERF.md)")
+                        "inverts the trade; 'corr' saves only the corr "
+                        "lookup output, ~180 MB at b8; PERF.md)")
     g.add_argument("--no_remat_loss_tail", action="store_true",
                    help="save the post-scan upsample/loss intermediates "
                         "across the loss backward instead of recomputing "
@@ -83,7 +84,8 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         fused_lookup={"auto": None, "on": True, "off": False}[
             getattr(args, "fused_lookup", "auto")],
         remat_loss_tail=not getattr(args, "no_remat_loss_tail", False),
-        refinement_save_policy={"auto": None, "on": True, "off": False}[
+        refinement_save_policy={"auto": None, "on": True, "off": False,
+                                "corr": "corr"}[
             getattr(args, "refinement_save_policy", "auto")],
     )
 

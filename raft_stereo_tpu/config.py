@@ -11,7 +11,7 @@ the public flag names are preserved because they are the reference's CLI API.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 # The plugin switch preserved from the reference (--corr_implementation,
 # core/raft_stereo.py:90-100). "reg_pallas"/"alt_pallas" replace the CUDA
@@ -114,7 +114,10 @@ class RAFTStereoConfig:
     # (models/raft_stereo.py refinement_save_policy_fits: ON at b4-like
     # residency, OFF at b8 where HBM pressure inverted the trade in r2).
     # bool forces either way — the A/B override the bench chain uses.
-    refinement_save_policy: Optional[bool] = None
+    # "corr" saves ONLY corr_feats: ~180 MB bf16 at SceneFlow b8 (vs the
+    # full set's ~2.7 GB), skipping the 4-level pyramid-lookup recompute
+    # in the backward without the gate-conv residency that loses at b8.
+    refinement_save_policy: Union[bool, str, None] = None
     # Ours: lax.scan unroll factor for the refinement loop. >1 replicates
     # the iteration body inside the while loop, amortizing per-iteration
     # dispatch overhead and letting XLA fuse across consecutive iterations
@@ -139,6 +142,10 @@ class RAFTStereoConfig:
             raise ValueError(
                 f"remat_encoders must be False, True, 'blocks' or 'norms', "
                 f"got {self.remat_encoders!r}")
+        if self.refinement_save_policy not in (None, False, True, "corr"):
+            raise ValueError(
+                f"refinement_save_policy must be None, False, True or "
+                f"'corr', got {self.refinement_save_policy!r}")
         if self.corr_storage_dtype not in (None, "float32", "bfloat16"):
             raise ValueError(
                 f"unknown corr_storage_dtype {self.corr_storage_dtype!r}; "

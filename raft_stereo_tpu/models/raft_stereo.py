@@ -13,6 +13,7 @@ raft_stereo.py:92-95).
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Optional, Tuple
 
 import jax
@@ -475,7 +476,26 @@ class RAFTStereo(nn.Module):
                       refinement_save_policy_fits(
                           cfg, iters, b, h, w, dt,
                           fused_lookup=use_fused_lookup))
-            if engage:
+            if engage == "corr" and use_fused_lookup:
+                # no standalone corr_feats tensor exists on the fused path
+                # (the kernel's backward recomputes from volumes+coords), so
+                # the "corr" policy would silently save nothing — fall back
+                # to full remat, loudly.
+                warnings.warn(
+                    "refinement_save_policy='corr' has no effect with "
+                    "fused_lookup (no corr_feats tensor exists to save); "
+                    "using full per-iteration remat")
+                engage = False
+            if engage == "corr":
+                # Save ONLY the corr lookup output: ~iters*B*h*w*36 values
+                # (~180 MB bf16 at SceneFlow b8 — vs ~2.7 GB for the full
+                # set), so the backward skips re-gathering the 4-level
+                # pyramid while the gate convs still rematerialize.
+                body = nn.remat(
+                    RefinementStep, prevent_cse=False,
+                    policy=jax.checkpoint_policies.save_only_these_names(
+                        "corr_feats"))
+            elif engage:
                 body = nn.remat(
                     RefinementStep, prevent_cse=False,
                     policy=jax.checkpoint_policies.save_only_these_names(
