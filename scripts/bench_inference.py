@@ -30,6 +30,10 @@ def main():
     parser.add_argument("--frames", type=int, default=12)
     parser.add_argument("--fused_lookup", choices=["auto", "on", "off"],
                         default="auto")
+    parser.add_argument("--scan_unroll", type=int, default=1,
+                        help="refinement-scan unroll factor (training A/B'd "
+                             "at b8 where it lost; inference at batch 1 is "
+                             "dispatch-heavier, hence the separate knob)")
     args = parser.parse_args()
 
     import jax
@@ -44,7 +48,8 @@ def main():
     }
     tri = {"auto": None, "on": True, "off": False}
     import dataclasses
-    presets = {k: (dataclasses.replace(c, fused_lookup=tri[args.fused_lookup]),
+    presets = {k: (dataclasses.replace(c, fused_lookup=tri[args.fused_lookup],
+                                       scan_unroll=args.scan_unroll),
                    it) for k, (c, it) in presets.items()}
     chosen = ["default", "realtime"] if args.preset == "both" else [args.preset]
 
