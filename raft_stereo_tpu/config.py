@@ -78,13 +78,17 @@ class RAFTStereoConfig:
     # residuals at train shapes. True = recompute both whole encoders
     # (one extra encoder forward); "blocks" = remat each trunk residual
     # block individually (saves block inputs only — most of the memory win
-    # at a fraction of the recompute); "norms" = save every conv output +
+    # at a fraction of the recompute); "blocks_hires" = remat only the
+    # three blocks whose input is at the post-stem resolution (their saves
+    # are ~10x the low-res blocks'; halves the recompute for ~1.7 GB more
+    # saves at SceneFlow b8); "norms" = save every conv output +
     # norm statistics and recompute only the elementwise norm/relu glue
     # (no conv re-runs — the fp32 norm intermediates and bool relu masks
     # are what dominate plain-backward residual memory).
     remat_encoders: "bool | str" = False
-    # Under remat_encoders="norms"/"blocks": save conv outputs ("norms") or
-    # remat-boundary block inputs ("blocks") in a lane-dense folded shape
+    # Under remat_encoders="norms"/"blocks"/"blocks_hires": save conv
+    # outputs ("norms") or remat-boundary block inputs (the blocks modes,
+    # "blocks_hires" resolving like "blocks") in a lane-dense folded shape
     # (64/96-channel saves are otherwise padded 2x/1.33x to the 128-lane
     # tile). None = auto, policy per remat mode: "norms" folds by estimated
     # padded size (its padded save set genuinely cannot fit a 16 GB chip at
@@ -138,10 +142,11 @@ class RAFTStereoConfig:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
             raise ValueError("n_gru_layers must be in {1,2,3}")
-        if self.remat_encoders not in (False, True, "blocks", "norms"):
+        if self.remat_encoders not in (False, True, "blocks", "blocks_hires",
+                                       "norms"):
             raise ValueError(
-                f"remat_encoders must be False, True, 'blocks' or 'norms', "
-                f"got {self.remat_encoders!r}")
+                f"remat_encoders must be False, True, 'blocks', "
+                f"'blocks_hires' or 'norms', got {self.remat_encoders!r}")
         if self.refinement_save_policy not in (None, False, True, "corr"):
             raise ValueError(
                 f"refinement_save_policy must be None, False, True or "

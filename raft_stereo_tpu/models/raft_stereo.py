@@ -301,7 +301,8 @@ class RAFTStereo(nn.Module):
                 "enc_conv", "enc_stat")
             _cnet_fwd = nn.remat(_cnet_fwd, policy=pol)
             _fnet_fwd = nn.remat(_fnet_fwd, policy=pol)
-        remat_blocks = cfg.remat_encoders == "blocks"
+        remat_blocks = ("hires" if cfg.remat_encoders == "blocks_hires"
+                        else cfg.remat_encoders == "blocks")
 
         # Lane-dense folded saves under the "norms" and "blocks" policies
         # (for "blocks" the fold applies to the remat boundary inputs —
@@ -317,7 +318,7 @@ class RAFTStereo(nn.Module):
                           else fold_enc_saves_auto(cfg, image1.shape[0],
                                                    image1.shape[1],
                                                    image1.shape[2]))
-        elif cfg.remat_encoders == "blocks":
+        elif cfg.remat_encoders in ("blocks", "blocks_hires"):
             fold_saves = bool(cfg.fold_enc_saves)
 
         cnet = MultiBasicEncoder(

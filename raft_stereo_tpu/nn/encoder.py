@@ -38,13 +38,29 @@ class _Trunk(nn.Module):
     norm_fn: str
     downsample: int
     dtype: Optional[Dtype] = None
-    remat_blocks: bool = False
+    remat_blocks: "bool | str" = False
     fold_saves: bool = False
 
     @nn.compact
     def __call__(self, x):
         d = self.dtype
         fs = self.fold_saves
+
+        # True: remat every trunk block. "hires": remat only the blocks
+        # whose INPUT is at the post-stem (largest) resolution — their saves
+        # are ~10x the low-res blocks', while the low-res blocks' recompute
+        # is half the policy's total cost; the in-between point for chips
+        # where the extra ~1.7 GB of low-res saves still fits (PERF.md r4).
+        # The set follows the stride pattern: layer2/layer3 only stride
+        # when downsample exceeds 1/0, so at small downsample later blocks
+        # also see post-stem resolution and join the set.
+        remat_set = None
+        if self.remat_blocks == "hires":
+            remat_set = {"layer1_0", "layer1_1", "layer2_0"}
+            if self.downsample <= 1:  # layer2_0 stride 1: still post-stem res
+                remat_set |= {"layer2_1", "layer3_0"}
+                if self.downsample == 0:  # layer3_0 stride 1 too
+                    remat_set |= {"layer3_1"}
 
         if self.remat_blocks:
             # Remat each block with a LANE-DENSE boundary: jax.checkpoint
@@ -58,6 +74,8 @@ class _Trunk(nn.Module):
             def _rb(in_planes, planes, stride, name):
                 block = ResidualBlock(in_planes, planes, self.norm_fn,
                                       stride, d, fs, name=name)
+                if remat_set is not None and name not in remat_set:
+                    return block
 
                 def apply_block(x):
                     b, h, w, c = x.shape
@@ -110,7 +128,7 @@ class BasicEncoder(nn.Module):
     downsample: int = 3
     dropout: float = 0.0
     dtype: Optional[Dtype] = None
-    remat_blocks: bool = False
+    remat_blocks: "bool | str" = False
     fold_saves: bool = False
 
     @nn.compact
@@ -151,7 +169,7 @@ class MultiBasicEncoder(nn.Module):
     downsample: int = 3
     dropout: float = 0.0
     dtype: Optional[Dtype] = None
-    remat_blocks: bool = False
+    remat_blocks: "bool | str" = False
     fold_saves: bool = False
 
     @nn.compact

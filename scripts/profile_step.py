@@ -68,7 +68,7 @@ def main():
                    help="profile the split-compilation step "
                         "(training/split_step.py)")
     p.add_argument("--remat_encoders", default=False,
-                   help="False | True | blocks")
+                   help="False | True | blocks | blocks_hires | norms")
     p.add_argument("--corr", default="reg")
     p.add_argument("--top", type=int, default=14)
     p.add_argument("--logdir", default="/tmp/profile_step")

@@ -144,13 +144,17 @@ def test_real_chain_shape():
     chain = bench._attempt_chain(True)
     assert chain[0]["when"] == "always" and chain[0]["timeout_s"]
     assert chain[1]["when"] == "below_par"
-    assert chain[1]["kw"]["remat_encoders"] == "blocks"
-    # the r4-measured best schedule is on both the primary and the banker
-    for att in chain[:2]:
+    assert chain[1]["kw"]["remat_encoders"] == "blocks_hires"
+    # the proven full blocks-remat config backs up the banker, below-par
+    # gated too (it must get its shot if the banker banks low or fails)
+    assert chain[2]["when"] == "below_par"
+    assert chain[2]["kw"]["remat_encoders"] == "blocks"
+    # the r4-measured best schedule is on the primary and both bankers
+    for att in chain[:3]:
         assert att["kw"]["remat_loss_tail"] is False
         assert att["kw"]["fold_enc_saves"] is False
         assert att["kw"]["upsample_tile_budget"] > 10 ** 9
-    assert all(a["when"] == "unbanked" for a in chain[2:])
+    assert all(a["when"] == "unbanked" for a in chain[3:])
     # the split-step attempt is gone (helper-rejected at b8 in r3 AND r4)
     assert not any(a["kw"].get("split_step") for a in chain)
     # every attempt is the SceneFlow recipe family

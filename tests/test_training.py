@@ -334,9 +334,9 @@ def test_encoder_remat_variants_identical():
 
     want_out = model0.apply(variables, img1, img2, iters=2)
     want_g = jax.grad(loss(model0))(variables["params"])
-    for variant in (True, "blocks", "norms"):
+    for variant in (True, "blocks", "blocks_hires", "norms"):
         kwargs = {"remat_encoders": variant}
-        if variant in ("norms", "blocks"):
+        if variant in ("norms", "blocks", "blocks_hires"):
             # also exercise the lane-dense folded saves (auto rule keeps
             # them off at test shapes); for "blocks" the fold wraps the
             # remat boundary itself (encoder.py apply_block)
