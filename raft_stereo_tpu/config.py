@@ -79,9 +79,10 @@ class RAFTStereoConfig:
     # (one extra encoder forward); "blocks" = remat each trunk residual
     # block individually (saves block inputs only — most of the memory win
     # at a fraction of the recompute); "blocks_hires" = remat only the
-    # three blocks whose input is at the post-stem resolution (their saves
-    # are ~10x the low-res blocks'; halves the recompute for ~1.7 GB more
-    # saves at SceneFlow b8); "norms" = save every conv output +
+    # blocks running entirely at post-stem resolution (layer1 at the
+    # shipped presets — their internals are the ~10x saves; ~2.7 GB more
+    # residency than "blocks" at SceneFlow b8 for a third of the
+    # recompute); "norms" = save every conv output +
     # norm statistics and recompute only the elementwise norm/relu glue
     # (no conv re-runs — the fp32 norm intermediates and bool relu masks
     # are what dominate plain-backward residual memory).

@@ -46,21 +46,25 @@ class _Trunk(nn.Module):
         d = self.dtype
         fs = self.fold_saves
 
-        # True: remat every trunk block. "hires": remat only the blocks
-        # whose INPUT is at the post-stem (largest) resolution — their saves
-        # are ~10x the low-res blocks', while the low-res blocks' recompute
-        # is half the policy's total cost; the in-between point for chips
-        # where the extra ~1.7 GB of low-res saves still fits (PERF.md r4).
-        # The set follows the stride pattern: layer2/layer3 only stride
-        # when downsample exceeds 1/0, so at small downsample later blocks
-        # also see post-stem resolution and join the set.
+        # True: remat every trunk block. "hires": remat only the blocks that
+        # RUN entirely at the post-stem (largest) resolution — their
+        # internals are the ~10x saves; every later block's internals are
+        # at reduced resolution and cost less to save than to recompute.
+        # The first STRIDING block is deliberately excluded even though its
+        # input is still post-stem-sized: its internals are already at the
+        # next (halved) resolution, and saving them measured another +1%
+        # over rematting it (PERF.md r4: 9.57 vs 9.48 pairs/s; rematting
+        # layer1_0 alone is rejected by the compile helper — the measured
+        # frontier). The set follows the stride pattern: layer2/layer3
+        # stride only when downsample exceeds 1/0, so at small downsample
+        # later blocks stay at post-stem resolution and join the set.
         remat_set = None
         if self.remat_blocks == "hires":
-            remat_set = {"layer1_0", "layer1_1", "layer2_0"}
-            if self.downsample <= 1:  # layer2_0 stride 1: still post-stem res
-                remat_set |= {"layer2_1", "layer3_0"}
-                if self.downsample == 0:  # layer3_0 stride 1 too
-                    remat_set |= {"layer3_1"}
+            remat_set = {"layer1_0", "layer1_1"}
+            if self.downsample <= 1:      # layer2 does not stride
+                remat_set |= {"layer2_0", "layer2_1"}
+                if self.downsample == 0:  # layer3 does not stride either
+                    remat_set |= {"layer3_0", "layer3_1"}
 
         if self.remat_blocks:
             # Remat each block with a LANE-DENSE boundary: jax.checkpoint

@@ -74,11 +74,12 @@ def test_below_par_control_runs_until_par():
 
 
 def test_below_par_control_skipped_at_par():
+    at_par = bench._PAR_PAIRS_PER_SEC + 0.05
     chain = _chain(("primary", "always", None),
                    ("control", "below_par", "unfused control"))
-    run, calls = _runner([("primary", _res(9.5))])
+    run, calls = _runner([("primary", _res(at_par))])
     best = bench.run_chain(chain, run)
-    assert best["value"] == 9.5
+    assert best["value"] == at_par
     assert calls == ["primary"]
 
 
