@@ -321,10 +321,19 @@ class RAFTStereo(nn.Module):
         elif cfg.remat_encoders in ("blocks", "blocks_hires"):
             fold_saves = bool(cfg.fold_enc_saves)
 
+        # Under "blocks_hires" the context encoder is saved WHOLE: its
+        # layer1 internals are ~1 GB at SceneFlow b8 (a third of fnet's
+        # doubled-batch set) and skipping its recompute measured +0.3%
+        # (9.61 vs 9.57 pairs/s, PERF.md r4); narrowing fnet further
+        # (layer1_0 only) is compile-helper-rejected. With shared_backbone
+        # the cnet IS the doubled-batch trunk, so it keeps the hires remat.
+        cnet_remat = remat_blocks
+        if remat_blocks == "hires" and not cfg.shared_backbone:
+            cnet_remat = False
         cnet = MultiBasicEncoder(
             output_dim=(cfg.hidden_dims, cfg.hidden_dims),
             norm_fn=cfg.context_norm, downsample=cfg.n_downsample, dtype=dt,
-            remat_blocks=remat_blocks, fold_saves=fold_saves, name="cnet")
+            remat_blocks=cnet_remat, fold_saves=fold_saves, name="cnet")
         if cfg.shared_backbone:
             *cnet_list, trunk = _cnet_fwd(
                 cnet, jnp.concatenate([image1, image2], axis=0))

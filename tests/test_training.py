@@ -444,6 +444,43 @@ def test_schedule_knobs_identical_train_step():
             assert rel < 1e-3, f"scan_unroll: leaf rel-L2 {rel:.2e}"
 
 
+def test_blocks_hires_shared_backbone_identical():
+    """Under blocks_hires the context encoder is saved whole ONLY when it is
+    not the shared backbone (models/raft_stereo.py cnet_remat); both layouts
+    must be pure scheduling. Exercises the realtime preset's shared-backbone
+    trunk, where cnet IS the doubled-batch encoder and keeps the remat."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.config import realtime_config
+    from raft_stereo_tpu.models import create_model, init_model
+
+    base = dataclasses.replace(realtime_config(), mixed_precision=False)
+    model0, variables = init_model(jax.random.PRNGKey(0), base, (1, 32, 48, 3))
+    rng = np.random.default_rng(5)
+    img1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)), jnp.float32)
+    rest = {k: v for k, v in variables.items() if k != "params"}
+
+    def loss(model):
+        def f(p):
+            out = model.apply({"params": p, **rest}, img1, img2, iters=2)
+            return jnp.mean(jnp.abs(out))
+        return f
+
+    want_out = model0.apply(variables, img1, img2, iters=2)
+    want_g = jax.grad(loss(model0))(variables["params"])
+    m = create_model(dataclasses.replace(base, remat_encoders="blocks_hires"))
+    got_out = m.apply(variables, img1, img2, iters=2)
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
+                               atol=1e-6)
+    got_g = jax.grad(loss(m))(variables["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(want_g),
+                    jax.tree_util.tree_leaves(got_g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-6)
+
+
 def test_refinement_save_policy_variants_identical():
     """refinement_save_policy in {False, True, 'corr'} is pure scheduling:
     forward outputs and parameter gradients must be identical. 'corr' saves
