@@ -47,7 +47,7 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               remat_encoders=False, split_step=False, fused_lookup=None,
               upsample_tile_budget=None, remat_loss_tail=True,
               fold_enc_saves=None, scan_unroll=1,
-              refinement_save_policy=None):
+              refinement_save_policy=None, corr_implementation="reg"):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
     # degraded windows (r3: every big graph rejected; r4: wedged for hours);
@@ -71,6 +71,7 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
     n_chips = jax.device_count()
 
     cfg = RAFTStereoConfig(mixed_precision=True,
+                           corr_implementation=corr_implementation,
                            corr_storage_dtype="bfloat16",
                            remat_encoders=remat_encoders,
                            fused_lookup=fused_lookup,
