@@ -55,6 +55,19 @@ def pytest_unconfigure(config):
     os._exit(_SESSION_EXIT_STATUS[0])
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_between_modules():
+    """Free compiled executables after each test module.
+
+    A full-suite run accumulates hundreds of XLA-CPU executables in one
+    process; at that load the LLVM JIT has been observed to SIGSEGV inside
+    ``backend_compile_and_load`` on a late heavy compile (the same test
+    passes in a 4-file run and in isolation). Dropping the jit caches per
+    module bounds the accumulation; tests recompile what they reuse."""
+    yield
+    jax.clear_caches()
+
+
 def reference_available() -> bool:
     return os.path.isdir(os.path.join(REFERENCE_DIR, "core"))
 
