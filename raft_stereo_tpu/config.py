@@ -152,6 +152,17 @@ class RAFTStereoConfig:
             raise ValueError(
                 f"refinement_save_policy must be None, False, True or "
                 f"'corr', got {self.refinement_save_policy!r}")
+        if (self.refinement_save_policy not in (None, False)
+                and not self.remat_refinement):
+            # mirror the loud fused_lookup-conflict fallback in the model:
+            # save policies choose which residuals the refinement REMAT
+            # keeps, so without remat they select nothing
+            import warnings
+            warnings.warn(
+                f"refinement_save_policy={self.refinement_save_policy!r} "
+                "has no effect with remat_refinement=False (save policies "
+                "select which residuals the refinement remat keeps); the "
+                "un-rematted scan saves everything anyway")
         if self.corr_storage_dtype not in (None, "float32", "bfloat16"):
             raise ValueError(
                 f"unknown corr_storage_dtype {self.corr_storage_dtype!r}; "

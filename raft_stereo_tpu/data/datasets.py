@@ -218,8 +218,13 @@ class SceneFlow(StereoDataset):
 
 class ETH3D(StereoDataset):
     def __init__(self, aug_params=None, root="datasets/ETH3D", split="training"):
+        # The reference ETH3D (stereo_datasets.py:187-189) reads disp0GT.pfm
+        # through plain read_gen, so ``valid`` is ``disp < 512`` — the nocc
+        # mask on disk is never read. (The Middlebury nocc reader here would
+        # silently change the validator's mask semantics; oracle-pinned in
+        # tests/test_eval_oracle.py.)
         super().__init__(aug_params, sparse=True,
-                         reader=frame_utils.read_disp_middlebury)
+                         reader=frame_utils.read_disp_eth3d)
         im0 = sorted(glob(osp.join(root, f"two_view_{split}/*/im0.png")))
         im1 = sorted(glob(osp.join(root, f"two_view_{split}/*/im1.png")))
         if split == "training":

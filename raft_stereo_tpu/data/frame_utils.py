@@ -151,6 +151,17 @@ def read_disp_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
     return disp, disp > 0.0
 
 
+def read_disp_eth3d(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """ETH3D GT: the reference reads disp0GT.pfm through plain ``read_gen``
+    (stereo_datasets.py:188-189 passes no reader), so validity is the generic
+    dense threshold ``disp < 512`` — the on-disk nocc mask is never consulted
+    (unlike Middlebury). Oracle-pinned in tests/test_eval_oracle.py."""
+    disp = read_pfm(path)
+    if disp.ndim == 3:
+        disp = disp[..., 0]
+    return disp, disp < 512.0
+
+
 def read_flow_kitti(path: str) -> Tuple[np.ndarray, np.ndarray]:
     import cv2
 

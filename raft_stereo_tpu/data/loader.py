@@ -62,6 +62,12 @@ class Loader:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.epoch = 0
+        # Consumed by the NEXT __iter__ only (then reset): resume support.
+        # Because sample (epoch, index) fully determines decode + augment
+        # (Philox keying below), skipping the first k batches of the
+        # restored epoch reproduces the exact stream a run that never
+        # stopped would have seen — no decode work is spent on the skip.
+        self.start_batch = 0
         if len(self) == 0:
             raise ValueError(
                 f"dataset of {len(dataset)} samples yields no batches at "
@@ -89,6 +95,12 @@ class Loader:
                     key=[(self.seed << 32) + epoch, 1 << 48])).shuffle(order)
 
         n_batches = len(self)
+        skip, self.start_batch = self.start_batch, 0
+        if skip:
+            # the permutation depends only on (seed, epoch), so dropping its
+            # first k*B entries resumes mid-epoch exactly
+            order = order[skip * self.batch_size:]
+            n_batches = max(n_batches - skip, 0)
         out: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 

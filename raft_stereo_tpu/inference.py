@@ -81,10 +81,9 @@ class StereoPredictor:
             self._compiled[key] = fn
         return fn
 
-    def __call__(self, image1: np.ndarray, image2: np.ndarray,
-                 iters: Optional[int] = None) -> np.ndarray:
-        """Batched NHWC uint8-range images -> flow-x ``(B, H, W, 1)`` (negative
-        disparity), matching the reference's ``flow_up`` output."""
+    def _prepared(self, image1, image2, iters):
+        """Shared pad/shard/compile-lookup for the timed and untimed paths."""
+        import contextlib
         iters = self.valid_iters if iters is None else iters
         image1 = jnp.asarray(image1, jnp.float32)
         image2 = jnp.asarray(image2, jnp.float32)
@@ -94,17 +93,50 @@ class StereoPredictor:
             target=(bucket_size(h, PAD_DIVIS, self.bucket),
                     bucket_size(w, self._w_divis, self.bucket)))
         im1, im2 = padder.pad(image1, image2)
-        import contextlib
         ctx = self._mesh if self._mesh is not None else contextlib.nullcontext()
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
             spec = NamedSharding(self._mesh, P(None, None, SEQ_AXIS, None))
             im1, im2 = jax.device_put(im1, spec), jax.device_put(im2, spec)
+        fn = self._forward(tuple(im1.shape[:3]), iters)
+        return padder, fn, im1, im2, ctx
+
+    def __call__(self, image1: np.ndarray, image2: np.ndarray,
+                 iters: Optional[int] = None) -> np.ndarray:
+        """Batched NHWC uint8-range images -> flow-x ``(B, H, W, 1)`` (negative
+        disparity), matching the reference's ``flow_up`` output. Untimed: one
+        dispatch, one D2H fetch — the timing discipline's extra round-trips
+        live only in :meth:`predict_timed`."""
+        padder, fn, im1, im2, ctx = self._prepared(image1, image2, iters)
         with ctx:
-            fn = self._forward(tuple(im1.shape[:3]), iters)
             _, flow_up = fn(self.variables, im1, im2)
         return np.asarray(padder.unpad(flow_up))
+
+    def predict_timed(self, image1: np.ndarray, image2: np.ndarray,
+                      iters: Optional[int] = None
+                      ) -> Tuple[np.ndarray, float]:
+        """Like ``__call__`` but also returns the DEVICE-ONLY seconds of the
+        jitted forward — the number comparable to the reference's model-call
+        timing (evaluate_stereo.py:77-79, which brackets only
+        ``model(image1, image2, ...)``, not padding or host transfer).
+
+        Timing discipline matches scripts/bench_inference.py: inputs are
+        settled on device before ``t0`` (their H2D transfer is excluded), and
+        the stop is a host fetch of one output element — on tunneled TPU
+        devices ``block_until_ready`` can return before queued executions
+        finish, but a host transfer of an output cannot complete until its
+        executable does. The full-array D2H fetch happens after ``t1``.
+        """
+        import time as _time
+        padder, fn, im1, im2, ctx = self._prepared(image1, image2, iters)
+        with ctx:
+            im1, im2 = jax.block_until_ready((im1, im2))
+            t0 = _time.perf_counter()
+            _, flow_up = fn(self.variables, im1, im2)
+            float(flow_up[0, 0, 0, 0])  # host fetch of one element = sync
+            dt = _time.perf_counter() - t0
+        return np.asarray(padder.unpad(flow_up)), dt
 
     def compute_disparity(self, left: np.ndarray, right: np.ndarray,
                           iters: Optional[int] = None) -> np.ndarray:

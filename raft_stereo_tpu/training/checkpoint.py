@@ -3,8 +3,10 @@
 The reference saves weights only and silently restarts the LR schedule on
 resume (train_stereo.py:184-186; SURVEY §5). Here a checkpoint restores model
 params, frozen batch stats, optimizer state, and the step counter (which also
-positions the OneCycle schedule and, in the trainer, repositions the loader's
-epoch counter — individual intra-epoch sample order is not restored).
+positions the OneCycle schedule and, in the trainer, repositions the loader
+EXACTLY — epoch and intra-epoch batch index both; the loader's Philox-keyed
+per-(epoch, index) decode makes the resumed stream identical to an
+uninterrupted run's, see data/loader.py).
 
 Weights-only interop with reference ``.pth`` files lives in
 :mod:`raft_stereo_tpu.utils.checkpoint_convert`.

@@ -82,9 +82,12 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
     loader = fetch_dataloader(cfg)
     accum_k = max(cfg.grad_accum_steps, 1)
     if int(state.step):
-        # reposition the data stream's epoch to match the restored step
-        # (intra-epoch order is not restored; see training/checkpoint.py)
+        # reposition the data stream to the restored step EXACTLY: epoch via
+        # integer division, intra-epoch position via Loader.start_batch (the
+        # Philox-keyed stream makes the skip bit-reproducible — a resumed run
+        # sees the same remaining batches as one that never stopped)
         loader.epoch = int(state.step) // max(len(loader), 1)
+        loader.start_batch = int(state.step) % max(len(loader), 1)
     # the exact schedule fetch_optimizer applies (shared, cannot desync)
     schedule = fetch_schedule(cfg)
 

@@ -97,13 +97,19 @@ def convert_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Dict]:
 
     Accepts tensors or numpy arrays; returns numpy fp32 leaves. Keys may or
     may not carry the DataParallel ``module.`` prefix.
+
+    Leaves are COPIES, never views: ``Tensor.numpy()`` shares storage with
+    the live torch parameter, and a same-dtype ``np.asarray`` keeps sharing
+    it — so torch's in-place optimizer updates would silently mutate the
+    "converted" pytree (found by scripts/parity_dynamics.py, where both
+    frameworks must start from the same snapshot while torch keeps training).
     """
     params: Dict = {}
     batch_stats: Dict = {}
     for key, val in state_dict.items():
         if hasattr(val, "detach"):  # torch tensor
             val = val.detach().cpu().numpy()
-        arr = np.asarray(val, dtype=np.float32)
+        arr = np.array(val, dtype=np.float32)  # copy, not view
         parts = key.split(".")
         if parts[0] == "module":
             parts = parts[1:]

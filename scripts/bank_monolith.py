@@ -1,0 +1,108 @@
+"""Compile-retry harness: bank the plain-b8 monolith into the persistent cache.
+
+The monolithic (no encoder remat) batch-8 train step is the fastest projected
+recipe (~10.3 pairs/s, PERF.md) but the tunneled remote-compile helper has
+rejected it in every session since round 1 — helper health varies by the
+hour, not by the graph. This harness retries an AOT compile-only attempt of
+EXACTLY the bench primary's graph (bench.py ``--attempt`` with
+``compile_only``) on a timer, in fresh subprocesses, until one healthy window
+lands the executable in the shared persistent ``.jax_cache`` — after which
+``bench.py``'s primary attempt hits the cache forever and the projected
+number becomes measurable.
+
+Secondary target (VERDICT r4 item 8): if the monolith keeps failing, the
+split-compilation step's b8 pieces (training/split_step.py) are tried in the
+same window so split_step can finally deliver ITS number.
+
+Every attempt is appended as a dated JSON line to ``runs/monolith_probe.log``
+so the round records either the bank or N dated windows that all failed.
+
+Run: python scripts/bank_monolith.py [--interval 1200] [--max-hours 10]
+     [--once] [--skip-split]
+"""
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import (  # noqa: E402  (no jax at module level)
+    FLAGSHIP_RECIPE, primary_attempt_kwargs, run_attempt_subprocess_detailed)
+
+LOG_PATH = os.path.join(REPO, "runs", "monolith_probe.log")
+
+# The bench primary's exact kwargs (single source: bench.py) plus
+# compile_only — identical config => identical HLO => identical cache key.
+MONOLITH = dict(compile_only=True, **primary_attempt_kwargs())
+SPLIT = dict(batch=8, fused_loss=True, split_step=True, compile_only=True,
+             **FLAGSHIP_RECIPE)
+
+
+def _attempt(kw, timeout_s):
+    # one protocol, one copy: bench.py owns launch/parse/lock (the parent-
+    # side .tpu_lock keeps probe windows and foreground bench runs off the
+    # chip simultaneously)
+    result, err, wall = run_attempt_subprocess_detailed(kw, timeout_s)
+    return result, None if err is None else err[:400], wall
+
+
+def _log(entry):
+    entry["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
+    os.makedirs(os.path.dirname(LOG_PATH), exist_ok=True)
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=float, default=1200.0,
+                   help="seconds between probe windows")
+    p.add_argument("--max-hours", type=float, default=10.0)
+    p.add_argument("--timeout", type=float, default=1200.0,
+                   help="per-attempt subprocess timeout")
+    p.add_argument("--once", action="store_true")
+    p.add_argument("--skip-split", action="store_true")
+    args = p.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    targets = {"monolith": MONOLITH}
+    if not args.skip_split:
+        targets["split_b8"] = SPLIT
+    banked, superseded = set(), set()
+    window = 0
+    while (time.time() < deadline
+           and len(banked | superseded) < len(targets)):
+        window += 1
+        for name, kw in targets.items():
+            if name in banked or name in superseded:
+                continue
+            result, err, dt = _attempt(kw, args.timeout)
+            _log({"window": window, "target": name,
+                  "ok": result is not None,
+                  "compile_s": None if result is None else result["value"],
+                  "error": err, "wall_s": round(dt, 1)})
+            if result is not None:
+                banked.add(name)
+            if "monolith" in banked and "split_b8" not in banked:
+                # the monolith supersedes split_step (VERDICT r4 item 8) —
+                # recorded as superseded, NOT banked: its pieces are not in
+                # the cache and a split_step attempt would still gamble
+                superseded.add("split_b8")
+        if args.once or len(banked | superseded) >= len(targets):
+            break
+        time.sleep(args.interval)
+    ok = "monolith" in banked
+    _log({"done": True, "banked": sorted(banked),
+          "superseded": sorted(superseded), "windows": window,
+          "monolith_banked": ok})
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Measure the batch-scaling frontier past b8 (VERDICT r4 item 2).
+
+PERF.md's ceiling argument rests on a ~450 ms batch-independent serial floor
+fitted from b2/b4/b8 (r1); the floor amortizes with per-chip batch, and the
+same linear model predicts ~12 pairs/s at b16 — but batch > 8 was never
+measured. This walks b10/b12/b16 at the SceneFlow recipe shape on the real
+chip, per batch trying the banker schedule first (hires-blocks remat + r4
+best schedule) and falling back to the memory-frugal schedule
+(remat_encoders=True + rematerialized loss tail + default chunk-on-pressure
+upsample budget) when the banker's residency no longer fits.
+
+Results append to runs/batch_frontier.log as dated JSON lines; attempts run
+through bench.py's locked subprocess runner so they serialize with the
+monolith prober and any driver bench run.
+
+Run: python scripts/batch_frontier.py [--batches 10 12 16]
+"""
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import FLAGSHIP_RECIPE, run_attempt_subprocess_detailed  # noqa: E402
+from raft_stereo_tpu.config import R4_BEST_SCHEDULE  # noqa: E402
+
+LOG = os.path.join(REPO, "runs", "batch_frontier.log")
+RECIPE = dict(fused_loss=True, **FLAGSHIP_RECIPE)
+
+
+def _log(entry):
+    entry["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", type=int, nargs="+", default=[10, 12, 16])
+    p.add_argument("--timeout", type=float, default=1500.0)
+    args = p.parse_args()
+
+    banker = dict(remat_encoders="blocks_hires", **R4_BEST_SCHEDULE)
+    frugal = dict(remat_encoders=True)  # remat_loss_tail defaults True,
+    # upsample_tile_budget defaults to chunk-on-pressure
+    best = None
+    for b in args.batches:
+        for name, sched in (("banker", banker), ("frugal", frugal)):
+            kw = dict(batch=b, **sched, **RECIPE)
+            result, err, wall = run_attempt_subprocess_detailed(
+                kw, args.timeout)
+            _log({"batch": b, "schedule": name,
+                  "ok": result is not None,
+                  "pairs_per_sec": None if result is None else result["value"],
+                  "error": None if err is None else err[:300],
+                  "wall_s": round(wall, 1)})
+            if result is not None:
+                if best is None or result["value"] > best[2]:
+                    best = (b, name, result["value"])
+                break  # banker fits at this batch; frugal not needed
+    _log({"done": True,
+          "best": None if best is None else
+          {"batch": best[0], "schedule": best[1], "pairs_per_sec": best[2]}})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Training-dynamics parity: torch reference vs this framework, side by side.
+
+``parity_trained.py`` proves the FORWARD path at trained scale (train torch,
+convert, compare inference). This script closes the remaining proxy for the
+"EPE within 1%" acceptance criterion (BASELINE.md) that is closable without
+the unreachable released weights: COMPOUNDING drift over optimization steps.
+Optimizer and loss are unit-parity-tested in isolation; here the whole
+training loop runs in both frameworks and the trajectories are compared:
+
+1. Build ONE torch reference model; convert its *initial* state so both
+   frameworks start from bit-identical weights (frozen BN, as the reference
+   trains — train_stereo.py:151 ``freeze_bn``; our ``make_train_step`` holds
+   ``batch_stats`` fixed by construction).
+2. Pre-generate an identical synthetic data stream (known-GT warped pairs,
+   scripts/parity_trained.py's generator) and run N AdamW+OneCycle steps in
+   each framework with the reference recipe (train_stereo.py:35-79: adjusted
+   gamma 0.9 sequence loss, lr 2e-4, wdecay 1e-5, eps 1e-8, OneCycle linear
+   pct_start 0.01 over N+100, global-norm clip 1.0), fp32 on CPU.
+3. Compare per-step loss trajectories (windowed means) and the final models'
+   EPE on held-out pairs, each framework evaluating its OWN trained weights
+   natively. Gate: final-EPE relative deviation and last-window loss
+   deviation within --tolerance (default 2%).
+
+Run: python scripts/parity_dynamics.py [--steps 400] [--out runs/parity_dynamics.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parity_trained import make_pair  # noqa: E402  (same synthetic generator)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--reference_dir", default="/root/reference")
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--train_size", type=int, nargs=2, default=[96, 192])
+    p.add_argument("--train_iters", type=int, default=7)
+    p.add_argument("--eval_size", type=int, nargs=2, default=[192, 384])
+    p.add_argument("--eval_iters", type=int, default=16)
+    p.add_argument("--eval_pairs", type=int, default=4)
+    p.add_argument("--seed", type=int, default=23)
+    p.add_argument("--window", type=int, default=50)
+    p.add_argument("--tolerance", type=float, default=0.02)
+    p.add_argument("--out", default="runs/parity_dynamics.json")
+    args = p.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import torch
+
+    sys.path.insert(0, args.reference_dir)
+    from core.raft_stereo import RAFTStereo as TorchRAFTStereo
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raft_stereo_tpu.models import create_model, init_model
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState, make_train_step
+    from raft_stereo_tpu.utils.checkpoint_convert import (
+        convert_state_dict, validate_against_variables)
+
+    th, tw = args.train_size
+    b, iters = args.batch, args.train_iters
+
+    # --- identical init ----------------------------------------------------
+    torch.manual_seed(args.seed)
+    targs = argparse.Namespace(
+        hidden_dims=[128, 128, 128], corr_implementation="reg",
+        shared_backbone=False, corr_levels=4, corr_radius=4, n_downsample=2,
+        context_norm="batch", slow_fast_gru=False, n_gru_layers=3,
+        mixed_precision=False)
+    tmodel = TorchRAFTStereo(targs)
+    cfg = RAFTStereoConfig()  # fp32
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, th, tw, 3))
+    converted = validate_against_variables(
+        convert_state_dict(tmodel.state_dict()), variables)
+
+    # --- identical data stream --------------------------------------------
+    rng = np.random.default_rng(args.seed)
+    print(f"pre-generating {args.steps} b{b} {th}x{tw} batches", flush=True)
+    stream = []
+    for _ in range(args.steps):
+        pairs = [make_pair(rng, th, tw) for _ in range(b)]
+        stream.append((
+            np.stack([p[0] for p in pairs]),            # (B,H,W,3)
+            np.stack([p[1] for p in pairs]),
+            np.stack([-p[2] for p in pairs])[..., None],  # flow-x = -disp
+        ))
+
+    # --- torch training loop (reference recipe, train_stereo.py:150-196) ---
+    tmodel.train()
+    tmodel.freeze_bn()
+    opt = torch.optim.AdamW(tmodel.parameters(), lr=2e-4,
+                            weight_decay=1e-5, eps=1e-8)
+    sched = torch.optim.lr_scheduler.OneCycleLR(
+        opt, 2e-4, args.steps + 100, pct_start=0.01,
+        cycle_momentum=False, anneal_strategy="linear")
+    gamma_adj = 0.9 ** (15.0 / max(iters - 1, 1))
+    t_losses = []
+    t0 = time.time()
+    for step, (i1, i2, f) in enumerate(stream):
+        im1 = torch.from_numpy(i1.transpose(0, 3, 1, 2))
+        im2 = torch.from_numpy(i2.transpose(0, 3, 1, 2))
+        flow_gt = torch.from_numpy(f.transpose(0, 3, 1, 2))
+        opt.zero_grad()
+        preds = tmodel(im1, im2, iters=iters)
+        loss = sum((gamma_adj ** (len(preds) - 1 - i)) *
+                   (pr[:, :1] - flow_gt).abs().mean()
+                   for i, pr in enumerate(preds))
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(tmodel.parameters(), 1.0)
+        opt.step()
+        sched.step()
+        t_losses.append(float(loss))
+        if step % 25 == 0:
+            print(f"torch step {step:4d} loss {t_losses[-1]:.4f} "
+                  f"({time.time()-t0:.0f}s)", flush=True)
+
+    # --- jax training loop (this framework's stack) -------------------------
+    tcfg = TrainConfig(batch_size=b, train_iters=iters, lr=2e-4,
+                       wdecay=1e-5, num_steps=args.steps,
+                       image_size=(th, tw))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(converted, tx)
+    step_fn = jax.jit(make_train_step(model, tx, iters))
+    j_losses = []
+    t0 = time.time()
+    for step, (i1, i2, f) in enumerate(stream):
+        batch = {"image1": jnp.asarray(i1), "image2": jnp.asarray(i2),
+                 "flow": jnp.asarray(f),
+                 "valid": jnp.ones((b, th, tw), jnp.float32)}
+        state, metrics = step_fn(state, batch)
+        j_losses.append(float(metrics["loss"]))
+        if step % 25 == 0:
+            print(f"jax   step {step:4d} loss {j_losses[-1]:.4f} "
+                  f"({time.time()-t0:.0f}s)", flush=True)
+
+    # --- compare trajectories ----------------------------------------------
+    t_arr, j_arr = np.asarray(t_losses), np.asarray(j_losses)
+    windows = []
+    for s in range(0, args.steps, args.window):
+        tm = float(t_arr[s:s + args.window].mean())
+        jm = float(j_arr[s:s + args.window].mean())
+        windows.append({"steps": [s, min(s + args.window, args.steps)],
+                        "torch": round(tm, 5), "jax": round(jm, 5),
+                        "rel_dev": round(abs(jm - tm) / max(tm, 1e-9), 5)})
+        print(f"window {windows[-1]['steps']}: torch {tm:.4f} "
+              f"jax {jm:.4f} rel {100*windows[-1]['rel_dev']:.2f}%",
+              flush=True)
+
+    # --- held-out EPE, each framework natively ------------------------------
+    eh, ew = args.eval_size
+    tmodel.eval()
+    t_epes, j_epes = [], []
+    for i in range(args.eval_pairs):
+        i1, i2, d = make_pair(rng, eh, ew)
+        with torch.no_grad():
+            _, t_up = tmodel(torch.from_numpy(i1.transpose(2, 0, 1))[None],
+                             torch.from_numpy(i2.transpose(2, 0, 1))[None],
+                             iters=args.eval_iters, test_mode=True)
+        t_epes.append(float(np.mean(np.abs(-t_up.numpy()[0, 0] - d))))
+        _, j_up = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.asarray(i1)[None], jnp.asarray(i2)[None],
+            iters=args.eval_iters, test_mode=True)
+        j_epes.append(float(np.mean(np.abs(-np.asarray(j_up)[0, ..., 0] - d))))
+        print(f"eval pair {i}: torch EPE {t_epes[-1]:.4f} "
+              f"jax EPE {j_epes[-1]:.4f}", flush=True)
+
+    t_epe, j_epe = float(np.mean(t_epes)), float(np.mean(j_epes))
+    epe_rel = abs(j_epe - t_epe) / max(t_epe, 1e-9)
+    last_rel = windows[-1]["rel_dev"]
+    summary = {
+        "steps": args.steps, "batch": b, "train_size": [th, tw],
+        "train_iters": iters, "windows": windows,
+        "final_epe": {"torch": round(t_epe, 5), "jax": round(j_epe, 5),
+                      "rel_dev": round(epe_rel, 5)},
+        "eval": {"size": [eh, ew], "iters": args.eval_iters,
+                 "pairs": args.eval_pairs},
+        "torch_losses": [round(x, 5) for x in t_losses],
+        "jax_losses": [round(x, 5) for x in j_losses],
+        "pass": bool(epe_rel <= args.tolerance
+                     and last_rel <= args.tolerance),
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(summary, fh, indent=1)
+    print(f"\nfinal EPE: torch {t_epe:.4f} jax {j_epe:.4f} "
+          f"rel {100*epe_rel:.2f}%  last-window loss rel "
+          f"{100*last_rel:.2f}%  -> "
+          f"{'PASS' if summary['pass'] else 'FAIL'} "
+          f"(tol {100*args.tolerance:.0f}%)", flush=True)
+    return 0 if summary["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,31 @@ def test_loader_batches_and_determinism(tmp_path):
             np.testing.assert_array_equal(ba[k], bb[k])
 
 
+def test_loader_mid_epoch_resume_exact(tmp_path):
+    """start_batch resumes inside an epoch EXACTLY: the skipped-ahead stream
+    equals the uninterrupted run's tail, and the following epoch is
+    untouched (trainer resume path, training/trainer.py)."""
+    _make_sceneflow_tree(tmp_path, n=6)
+    ds = SceneFlow(aug_params={"crop_size": (32, 48)}, root=str(tmp_path))
+    continuous = Loader(ds, batch_size=2, seed=7, num_workers=2)
+    epoch0 = list(continuous)           # 3 batches
+    epoch1 = list(continuous)
+
+    resumed = Loader(ds, batch_size=2, seed=7, num_workers=2)
+    resumed.epoch = 0
+    resumed.start_batch = 2             # as if restored at global step 2
+    tail = list(resumed)
+    assert len(tail) == 1
+    for k in epoch0[2]:
+        np.testing.assert_array_equal(tail[0][k], epoch0[2][k])
+    # start_batch is consume-once: the next epoch is complete and identical
+    nxt = list(resumed)
+    assert len(nxt) == 3
+    for ba, bb in zip(nxt, epoch1):
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k])
+
+
 def test_loader_epochs_differ(tmp_path):
     _make_sceneflow_tree(tmp_path, n=4)
     ds = SceneFlow(aug_params={"crop_size": (32, 48)}, root=str(tmp_path))

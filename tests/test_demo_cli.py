@@ -1,0 +1,75 @@
+"""demo.py driven end-to-end: PNG pair -> console script -> colormap + .npy.
+
+The reference demo (demo.py:23-52) is a glob -> model -> jet-PNG pipeline;
+this pins ours as an actual CLI drive (arg parsing, checkpoint restore,
+predictor, output files), not just library calls — r4 review asked for the
+"driven end-to-end in verification" claim to live in the suite.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.training.checkpoint import save_train_state
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    """Orbax full-train-state checkpoint with random-init weights."""
+    root = tmp_path_factory.mktemp("demo_ckpt")
+    _, variables = init_model(jax.random.PRNGKey(0), RAFTStereoConfig(),
+                              (1, 48, 96, 3))
+    state = TrainState.create(variables, fetch_optimizer(TrainConfig()))
+    save_train_state(str(root), "tiny", jax.device_get(state))
+    return str(root / "tiny")
+
+
+def test_demo_end_to_end(tmp_path, tiny_ckpt, monkeypatch):
+    rng = np.random.default_rng(3)
+    for i in range(2):
+        for side in ("left", "right"):
+            Image.fromarray(rng.integers(0, 255, (48, 96, 3), dtype=np.uint8)
+                            ).save(tmp_path / f"{side}_{i}.png")
+    out_dir = tmp_path / "out"
+
+    import demo  # repo-root CLI module (console script `raft-stereo-demo`)
+
+    monkeypatch.setattr(sys, "argv", [
+        "demo.py", "--restore_ckpt", tiny_ckpt,
+        "-l", str(tmp_path / "left_*.png"),
+        "-r", str(tmp_path / "right_*.png"),
+        "--output_directory", str(out_dir),
+        "--valid_iters", "2", "--save_numpy",
+    ])
+    demo.main()
+
+    for i in range(2):
+        png = out_dir / f"left_{i}-disparity.png"
+        npy = out_dir / f"left_{i}.npy"
+        assert png.exists() and npy.exists()
+        disp = np.load(npy)
+        assert disp.shape == (48, 96)
+        assert np.isfinite(disp).all()
+        # the colormapped PNG decodes to the input's spatial shape
+        assert np.asarray(Image.open(png)).shape[:2] == (48, 96)
+
+
+def test_demo_mismatched_globs_exit(tmp_path, tiny_ckpt, monkeypatch):
+    Image.fromarray(np.zeros((48, 96, 3), np.uint8)).save(tmp_path / "l0.png")
+    import demo
+
+    monkeypatch.setattr(sys, "argv", [
+        "demo.py", "--restore_ckpt", tiny_ckpt,
+        "-l", str(tmp_path / "l*.png"), "-r", str(tmp_path / "r*.png"),
+        "--output_directory", str(tmp_path / "out"),
+    ])
+    with pytest.raises(SystemExit):
+        demo.main()

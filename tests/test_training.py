@@ -518,6 +518,59 @@ def test_refinement_save_policy_variants_identical():
                                        atol=1e-6, err_msg=str(variant))
 
 
+def test_save_policy_corr_with_fused_lookup_warns_and_matches():
+    """'corr' + fused_lookup: no corr_feats tensor exists on the fused path,
+    so the model must warn and fall back to full remat with outputs and
+    grads unchanged (models/raft_stereo.py fallback branch). Width 352 keeps
+    every pyramid level above the fused kernel's 2r+2 applicability bound."""
+    import pytest as _pytest
+
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import create_model, init_model
+
+    shape = (1, 32, 352, 3)
+    base = RAFTStereoConfig(fused_lookup=True, refinement_save_policy=False)
+    model0, variables = init_model(jax.random.PRNGKey(0), base, shape)
+    rng = np.random.default_rng(5)
+    img1 = jnp.asarray(rng.uniform(0, 255, shape), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, shape), jnp.float32)
+    rest = {k: v for k, v in variables.items() if k != "params"}
+
+    def loss(model):
+        def f(p):
+            out = model.apply({"params": p, **rest}, img1, img2, iters=2)
+            return jnp.mean(jnp.abs(out))
+        return f
+
+    want_out = model0.apply(variables, img1, img2, iters=2)
+    want_g = jax.grad(loss(model0))(variables["params"])
+
+    m = create_model(RAFTStereoConfig(fused_lookup=True,
+                                      refinement_save_policy="corr"))
+    with _pytest.warns(UserWarning, match="no effect with fused_lookup"):
+        got_out = m.apply(variables, img1, img2, iters=2)
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
+                               atol=1e-6)
+    with _pytest.warns(UserWarning, match="no effect with fused_lookup"):
+        got_g = jax.grad(loss(m))(variables["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(want_g),
+                    jax.tree_util.tree_leaves(got_g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-6)
+
+
+def test_save_policy_without_remat_warns():
+    """An explicit save policy with remat_refinement=False selects nothing;
+    the config rejects the silent no-op loudly (ADVICE r4)."""
+    import pytest as _pytest
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+
+    with _pytest.warns(UserWarning, match="remat_refinement=False"):
+        RAFTStereoConfig(remat_refinement=False, refinement_save_policy=True)
+
+
 def test_grad_accumulation_updates_every_k():
     """optax.MultiSteps wiring: params move only on each k-th micro-step."""
     import jax
