@@ -26,6 +26,7 @@ banks a number before anything risky, with the full blocks-remat config
 BEST successful JSON even if other attempts fail.
 """
 
+import datetime
 import json
 import os
 import subprocess
@@ -333,6 +334,17 @@ def run_attempt_subprocess_detailed(kw, timeout_s=None, lock_wait_s=1800.0):
                  or "INTERNAL" in l][-3:]
     tail = "\n".join(err_lines or lines[-8:])
     return (None, f"rc={proc.returncode}: {tail}", time.monotonic() - t0)
+
+
+def append_json_log(path, entry):
+    """Dated JSON-line append shared by the measurement harnesses
+    (scripts/bank_monolith.py, scripts/batch_frontier.py): one logging
+    protocol, one copy."""
+    entry["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
 
 
 def _run_attempt_subprocess(kw, timeout_s=None):

@@ -17,7 +17,8 @@ dataset-specific metric definitions:
 
 EPE is the mean of per-image means in every validator. The aggregation
 differences across validators are the reference's, kept so numbers are
-comparable to what it prints (oracle-pinned in tests/test_eval.py).
+comparable to what it prints (oracle-pinned in tests/test_eval_oracle.py,
+which runs the reference's own validate_* as the oracle).
 
 All metric arithmetic happens in numpy on the host — the device computes only
 the forward pass, via :class:`raft_stereo_tpu.inference.StereoPredictor`

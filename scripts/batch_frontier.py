@@ -17,15 +17,14 @@ Run: python scripts/batch_frontier.py [--batches 10 12 16]
 """
 
 import argparse
-import datetime
-import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import FLAGSHIP_RECIPE, run_attempt_subprocess_detailed  # noqa: E402
+from bench import (  # noqa: E402
+    FLAGSHIP_RECIPE, append_json_log, run_attempt_subprocess_detailed)
 from raft_stereo_tpu.config import R4_BEST_SCHEDULE  # noqa: E402
 
 LOG = os.path.join(REPO, "runs", "batch_frontier.log")
@@ -33,11 +32,7 @@ RECIPE = dict(fused_loss=True, **FLAGSHIP_RECIPE)
 
 
 def _log(entry):
-    entry["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
-    os.makedirs(os.path.dirname(LOG), exist_ok=True)
-    with open(LOG, "a") as f:
-        f.write(json.dumps(entry) + "\n")
-    print(json.dumps(entry), flush=True)
+    append_json_log(LOG, entry)
 
 
 def main():
