@@ -47,7 +47,7 @@ _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "4800"))
 
 
 def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
-              remat_encoders=False, split_step=False, fused_lookup=None,
+              remat_encoders=False, fused_lookup=None,
               upsample_tile_budget=None, remat_loss_tail=True,
               fold_enc_saves=None, scan_unroll=1,
               refinement_save_policy=None, corr_implementation="reg",
@@ -111,12 +111,6 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
         batch_data = shard_batch(mesh, batch_data)
         step = make_pjit_train_step(model, tx, train_iters, mesh,
                                     fused_loss=fused_loss)
-    elif split_step:
-        # three-piece split compilation (training/split_step.py) for graphs
-        # the degraded remote compile helper rejects monolithically
-        from raft_stereo_tpu.training.split_step import make_split_train_step
-        step = make_split_train_step(model, tx, train_iters,
-                                     fused_loss=fused_loss)
     else:
         step = jax.jit(make_train_step(model, tx, train_iters,
                                        fused_loss=fused_loss),
@@ -128,15 +122,9 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
         # persistent cache — no timed steps. Once a degraded-helper recipe
         # compiles in one healthy window, every later timed attempt hits the
         # cache. ``lower().compile()`` produces the identical cache key to
-        # calling the jitted step (same HLO, same compile options); the
-        # split-step path has no single lowerable callable, so it banks its
-        # pieces by executing one step instead.
+        # calling the jitted step (same HLO, same compile options).
         t0 = time.perf_counter()
-        if hasattr(step, "lower"):
-            step.lower(state, batch_data).compile()
-        else:
-            out_state, metrics = step(state, batch_data)
-            float(metrics["loss"])
+        step.lower(state, batch_data).compile()
         dt = time.perf_counter() - t0
         return {
             "metric": "compile_only",
@@ -146,7 +134,6 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
             "batch": batch,
             "train_iters": train_iters,
             "image_size": [h, w],
-            "split_step": bool(split_step),
         }
 
     # Warmup: compile + one steady-state step. The loss fetch (device->host
@@ -266,8 +253,10 @@ def _attempt_chain(on_tpu):
                      **recipe),
              when="unbanked", note="rematerialized-tail fallback"),
         # Fallbacks, expected slower than the banker — only run while
-        # nothing is banked. (The split-step attempt is gone: its pieces
-        # were helper-rejected at b8 in both r3 and r4 — see PERF.md.)
+        # nothing is banked. (split_step was DELETED in r5: its b8 pieces
+        # hit the same deterministic compile-subprocess bug as the monolith
+        # in every probe window, falsifying its premise — see PERF.md "r5:
+        # the monolith rejection root-caused".)
         dict(kw=dict(batch=8, fused_loss=True, remat_encoders="norms",
                      **recipe),
              when="unbanked", note="norms-remat fallback, same recipe"),

@@ -241,9 +241,10 @@ class RAFTStereo(nn.Module):
         final flow_up (B, H, W, 1))`` instead of the stacked predictions —
         same math as sequence_loss over the stack, far less HBM traffic.
 
-        ``stage`` supports split-compilation (training/split_step.py: the
-        remote compile helper rejects the monolithic flagship graph while
-        its pieces compile):
+        ``stage`` exposes the forward as separately-jittable pieces (e.g.
+        encode once / refine many times with warm starts, or staged
+        compilation of graphs a compile service rejects whole —
+        oracle-pinned in tests/test_staged_forward.py):
 
         * ``"full"`` (default) — the whole forward, single graph.
         * ``"encode"`` — run only the encoders; returns

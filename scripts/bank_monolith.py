@@ -15,17 +15,16 @@ terminal shunts big graphs to a ``tpu_compile_helper`` subprocess whose
 failure is DETERMINISTIC for over-threshold graphs, not helper weather
 (PERF.md "r5: the monolith rejection root-caused"). The probe stays useful
 as a canary for the terminal image getting fixed; its dated failure log is
-the round's record either way.
-
-Secondary target (VERDICT r4 item 8): if the monolith keeps failing, the
-split-compilation step's b8 pieces (training/split_step.py) are tried in the
-same window so split_step can finally deliver ITS number.
+the round's record either way. (The split-compilation step this harness
+also probed in early r5 windows was deleted the same round: its b8 pieces
+hit the same deterministic bug, falsifying its premise that pieces compile
+where the monolith does not.)
 
 Every attempt is appended as a dated JSON line to ``runs/monolith_probe.log``
 so the round records either the bank or N dated windows that all failed.
 
 Run: python scripts/bank_monolith.py [--interval 1200] [--max-hours 10]
-     [--once] [--skip-split]
+     [--once]
 """
 
 import argparse
@@ -37,7 +36,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from bench import (  # noqa: E402  (no jax at module level)
-    FLAGSHIP_RECIPE, append_json_log, primary_attempt_kwargs,
+    append_json_log, primary_attempt_kwargs,
     run_attempt_subprocess_detailed)
 
 LOG_PATH = os.path.join(REPO, "runs", "monolith_probe.log")
@@ -45,16 +44,6 @@ LOG_PATH = os.path.join(REPO, "runs", "monolith_probe.log")
 # The bench primary's exact kwargs (single source: bench.py) plus
 # compile_only — identical config => identical HLO => identical cache key.
 MONOLITH = dict(compile_only=True, **primary_attempt_kwargs())
-SPLIT = dict(batch=8, fused_loss=True, split_step=True, compile_only=True,
-             **FLAGSHIP_RECIPE)
-
-
-def _attempt(kw, timeout_s):
-    # one protocol, one copy: bench.py owns launch/parse/lock (the parent-
-    # side .tpu_lock keeps probe windows and foreground bench runs off the
-    # chip simultaneously)
-    result, err, wall = run_attempt_subprocess_detailed(kw, timeout_s)
-    return result, None if err is None else err[:400], wall
 
 
 def _log(entry):
@@ -69,41 +58,26 @@ def main():
     p.add_argument("--timeout", type=float, default=1200.0,
                    help="per-attempt subprocess timeout")
     p.add_argument("--once", action="store_true")
-    p.add_argument("--skip-split", action="store_true")
     args = p.parse_args()
 
     deadline = time.time() + args.max_hours * 3600
-    targets = {"monolith": MONOLITH}
-    if not args.skip_split:
-        targets["split_b8"] = SPLIT
-    banked, superseded = set(), set()
+    banked = False
     window = 0
-    while (time.time() < deadline
-           and len(banked | superseded) < len(targets)):
+    while time.time() < deadline and not banked:
         window += 1
-        for name, kw in targets.items():
-            if name in banked or name in superseded:
-                continue
-            result, err, dt = _attempt(kw, args.timeout)
-            _log({"window": window, "target": name,
-                  "ok": result is not None,
-                  "compile_s": None if result is None else result["value"],
-                  "error": err, "wall_s": round(dt, 1)})
-            if result is not None:
-                banked.add(name)
-            if "monolith" in banked and "split_b8" not in banked:
-                # the monolith supersedes split_step (VERDICT r4 item 8) —
-                # recorded as superseded, NOT banked: its pieces are not in
-                # the cache and a split_step attempt would still gamble
-                superseded.add("split_b8")
-        if args.once or len(banked | superseded) >= len(targets):
+        result, err, dt = run_attempt_subprocess_detailed(
+            MONOLITH, args.timeout)
+        _log({"window": window, "target": "monolith",
+              "ok": result is not None,
+              "compile_s": None if result is None else result["value"],
+              "error": None if err is None else err[:400],
+              "wall_s": round(dt, 1)})
+        banked = result is not None
+        if args.once or banked:
             break
         time.sleep(args.interval)
-    ok = "monolith" in banked
-    _log({"done": True, "banked": sorted(banked),
-          "superseded": sorted(superseded), "windows": window,
-          "monolith_banked": ok})
-    return 0 if ok else 1
+    _log({"done": True, "windows": window, "monolith_banked": banked})
+    return 0 if banked else 1
 
 
 if __name__ == "__main__":

@@ -64,9 +64,6 @@ def main():
     p.add_argument("--steps", type=int, default=3)
     p.add_argument("--stacked", action="store_true",
                    help="stacked-loss step instead of deferred-fused")
-    p.add_argument("--split_step", action="store_true",
-                   help="profile the split-compilation step "
-                        "(training/split_step.py)")
     p.add_argument("--remat_encoders", default=False,
                    help="False | True | blocks | blocks_hires | norms")
     p.add_argument("--corr", default="reg")
@@ -103,14 +100,9 @@ def main():
                                     jnp.float32) * 50,
         "valid": jnp.ones((args.batch, args.h, args.w), jnp.float32),
     }
-    if args.split_step:
-        from raft_stereo_tpu.training.split_step import make_split_train_step
-        step = make_split_train_step(model, tx, args.iters,
-                                     fused_loss=not args.stacked)
-    else:
-        step = jax.jit(make_train_step(model, tx, args.iters,
-                                       fused_loss=not args.stacked),
-                       donate_argnums=(0,))
+    step = jax.jit(make_train_step(model, tx, args.iters,
+                                   fused_loss=not args.stacked),
+                   donate_argnums=(0,))
     state, m = step(state, batch)
     float(m["loss"])
     state, m = step(state, batch)

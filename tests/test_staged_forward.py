@@ -1,0 +1,60 @@
+"""Staged forward (stage='encode' / 'refine') vs the monolithic apply.
+
+The model's ``stage`` parameter exposes the forward as separately-jittable
+pieces; 'full' must be exactly refine(encode(x)) — parameters, outputs and
+gradients identical up to XLA scheduling. (This pins the API directly; the
+split-compilation *training step* that once consumed it was deleted in r5
+after its compile-service premise was falsified — see PERF.md.)
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import init_model
+
+SHAPE = (1, 32, 48, 3)
+
+
+def _data():
+    rng = np.random.default_rng(11)
+    return (jnp.asarray(rng.uniform(0, 255, SHAPE), jnp.float32),
+            jnp.asarray(rng.uniform(0, 255, SHAPE), jnp.float32))
+
+
+def test_staged_forward_matches_full():
+    model, variables = init_model(jax.random.PRNGKey(0), RAFTStereoConfig(),
+                                  SHAPE)
+    img1, img2 = _data()
+    full = model.apply(variables, img1, img2, iters=2)
+    enc = model.apply(variables, img1, img2, stage="encode")
+    staged = model.apply(variables, img1, img2, iters=2, stage="refine",
+                         enc_outs=enc)
+    np.testing.assert_allclose(np.asarray(staged), np.asarray(full),
+                               atol=1e-6)
+
+
+def test_staged_grads_match_full():
+    model, variables = init_model(jax.random.PRNGKey(0), RAFTStereoConfig(),
+                                  SHAPE)
+    img1, img2 = _data()
+    rest = {k: v for k, v in variables.items() if k != "params"}
+
+    def loss_full(p):
+        out = model.apply({"params": p, **rest}, img1, img2, iters=2)
+        return jnp.mean(jnp.abs(out))
+
+    def loss_staged(p):
+        v = {"params": p, **rest}
+        enc = model.apply(v, img1, img2, stage="encode")
+        out = model.apply(v, img1, img2, iters=2, stage="refine",
+                          enc_outs=enc)
+        return jnp.mean(jnp.abs(out))
+
+    g_full = jax.grad(loss_full)(variables["params"])
+    g_staged = jax.grad(loss_staged)(variables["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_staged)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-6)
