@@ -252,6 +252,16 @@ def _attempt_chain(on_tpu):
         dict(kw=dict(batch=8, fused_loss=True, remat_encoders="blocks",
                      **recipe),
              when="unbanked", note="rematerialized-tail fallback"),
+        # The r5 batch-frontier's best non-b8 point (9.01 measured; the
+        # full-encoder-remat family is the only schedule the terminal's
+        # compile subprocess accepts above b8 — PERF.md "r5: the batch-scaling frontier").
+        # NOT the reference recipe's batch: the JSON carries batch=16 so
+        # the row is clearly labeled; it only runs if every b8 path above
+        # failed to bank.
+        dict(kw=dict(batch=16, fused_loss=True, remat_encoders=True,
+                     **recipe),
+             when="unbanked", note="b16 frontier fallback (non-reference "
+                                   "batch, see PERF.md batch-scaling frontier)"),
         # Fallbacks, expected slower than the banker — only run while
         # nothing is banked. (split_step was DELETED in r5: its b8 pieces
         # hit the same deterministic compile-subprocess bug as the monolith
