@@ -19,10 +19,15 @@ training loop runs in both frameworks and the trajectories are compared:
    pct_start 0.01 over N+100, global-norm clip 1.0), fp32 on CPU.
 3. Compare per-step loss trajectories (windowed means) and the final models'
    EPE on held-out pairs, each framework evaluating its OWN trained weights
-   natively. Gate: final-EPE relative deviation and last-window loss
-   deviation within --tolerance (default 2%).
+   natively. GATE: last-window loss deviation within --tolerance (default
+   2%) — the training-dynamics criterion. Final EPE over a few pairs is
+   chaos-dominated and is reported, not gated: judge it against the
+   same-framework floor that ``--mode null`` measures (torch trained twice
+   from a 1e-6-perturbed init deviates 8.0% EPE / 3.4% loss at 300 steps
+   — larger than the cross-framework deviation on both axes).
 
 Run: python scripts/parity_dynamics.py [--steps 400] [--out runs/parity_dynamics.json]
+     python scripts/parity_dynamics.py --mode null   # chaos-floor yardstick
 """
 
 import argparse
@@ -52,7 +57,18 @@ def main():
     p.add_argument("--window", type=int, default=50)
     p.add_argument("--tolerance", type=float, default=0.02)
     p.add_argument("--out", default="runs/parity_dynamics.json")
+    p.add_argument("--mode", choices=["both", "null"], default="both",
+                   help="'both' trains torch and jax side by side; 'null' "
+                        "trains torch TWICE (the second from an init "
+                        "perturbed by --perturb) on the same stream — the "
+                        "measured chaos floor that bounds how close two "
+                        "trainings of THE SAME framework can be expected "
+                        "to land, the yardstick for the 'both' deviations")
+    p.add_argument("--perturb", type=float, default=1e-6)
     args = p.parse_args()
+    if args.mode == "null" and args.out == p.get_default("out"):
+        # never clobber the cross-framework artifact with the null summary
+        args.out = "runs/parity_dynamics_null.json"
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -102,33 +118,89 @@ def main():
         ))
 
     # --- torch training loop (reference recipe, train_stereo.py:150-196) ---
-    tmodel.train()
-    tmodel.freeze_bn()
-    opt = torch.optim.AdamW(tmodel.parameters(), lr=2e-4,
-                            weight_decay=1e-5, eps=1e-8)
-    sched = torch.optim.lr_scheduler.OneCycleLR(
-        opt, 2e-4, args.steps + 100, pct_start=0.01,
-        cycle_momentum=False, anneal_strategy="linear")
-    gamma_adj = 0.9 ** (15.0 / max(iters - 1, 1))
-    t_losses = []
-    t0 = time.time()
-    for step, (i1, i2, f) in enumerate(stream):
-        im1 = torch.from_numpy(i1.transpose(0, 3, 1, 2))
-        im2 = torch.from_numpy(i2.transpose(0, 3, 1, 2))
-        flow_gt = torch.from_numpy(f.transpose(0, 3, 1, 2))
-        opt.zero_grad()
-        preds = tmodel(im1, im2, iters=iters)
-        loss = sum((gamma_adj ** (len(preds) - 1 - i)) *
-                   (pr[:, :1] - flow_gt).abs().mean()
-                   for i, pr in enumerate(preds))
-        loss.backward()
-        torch.nn.utils.clip_grad_norm_(tmodel.parameters(), 1.0)
-        opt.step()
-        sched.step()
-        t_losses.append(float(loss))
-        if step % 25 == 0:
-            print(f"torch step {step:4d} loss {t_losses[-1]:.4f} "
-                  f"({time.time()-t0:.0f}s)", flush=True)
+    def torch_train(model_, tag):
+        model_.train()
+        model_.freeze_bn()
+        opt = torch.optim.AdamW(model_.parameters(), lr=2e-4,
+                                weight_decay=1e-5, eps=1e-8)
+        sched = torch.optim.lr_scheduler.OneCycleLR(
+            opt, 2e-4, args.steps + 100, pct_start=0.01,
+            cycle_momentum=False, anneal_strategy="linear")
+        gamma_adj = 0.9 ** (15.0 / max(iters - 1, 1))
+        losses = []
+        t0 = time.time()
+        for step, (i1, i2, f) in enumerate(stream):
+            im1 = torch.from_numpy(i1.transpose(0, 3, 1, 2))
+            im2 = torch.from_numpy(i2.transpose(0, 3, 1, 2))
+            flow_gt = torch.from_numpy(f.transpose(0, 3, 1, 2))
+            opt.zero_grad()
+            preds = model_(im1, im2, iters=iters)
+            loss = sum((gamma_adj ** (len(preds) - 1 - i)) *
+                       (pr[:, :1] - flow_gt).abs().mean()
+                       for i, pr in enumerate(preds))
+            loss.backward()
+            torch.nn.utils.clip_grad_norm_(model_.parameters(), 1.0)
+            opt.step()
+            sched.step()
+            losses.append(float(loss))
+            if step % 25 == 0:
+                print(f"{tag} step {step:4d} loss {losses[-1]:.4f} "
+                      f"({time.time()-t0:.0f}s)", flush=True)
+        model_.eval()
+        return losses
+
+    def torch_eval(model_, pairs):
+        epes = []
+        for i1, i2, d in pairs:
+            with torch.no_grad():
+                _, up = model_(torch.from_numpy(i1.transpose(2, 0, 1))[None],
+                               torch.from_numpy(i2.transpose(2, 0, 1))[None],
+                               iters=args.eval_iters, test_mode=True)
+            epes.append(float(np.mean(np.abs(-up.numpy()[0, 0] - d))))
+        return epes
+
+    if args.mode == "null":
+        # Chaos-floor measurement: the SAME framework trained twice from
+        # inits differing by --perturb * N(0,1). Whatever deviation this
+        # produces after the same stream is the noise floor against which
+        # the torch-vs-jax numbers must be read — two fp32 trainings are
+        # chaotic amplifiers, not reproducible functions.
+        eh, ew = args.eval_size
+        torch.manual_seed(args.seed)
+        tmodel_b = TorchRAFTStereo(targs)  # bit-identical init
+        g = torch.Generator().manual_seed(12345)
+        with torch.no_grad():
+            for p_ in tmodel_b.parameters():
+                p_.add_(args.perturb *
+                        torch.randn(p_.shape, generator=g))
+        a_losses = torch_train(tmodel, "torch/a")
+        b_losses = torch_train(tmodel_b, "torch/b")
+        pairs = [make_pair(rng, eh, ew) for _ in range(args.eval_pairs)]
+        a_epes, b_epes = torch_eval(tmodel, pairs), torch_eval(tmodel_b, pairs)
+        a_arr, b_arr = np.asarray(a_losses), np.asarray(b_losses)
+        last = slice(args.steps - args.window, args.steps)
+        loss_rel = abs(b_arr[last].mean() - a_arr[last].mean()) / \
+            max(a_arr[last].mean(), 1e-9)
+        a_epe, b_epe = float(np.mean(a_epes)), float(np.mean(b_epes))
+        epe_rel = abs(b_epe - a_epe) / max(a_epe, 1e-9)
+        summary = {
+            "mode": "null", "perturb": args.perturb, "steps": args.steps,
+            "last_window_loss_rel": round(float(loss_rel), 5),
+            "final_epe": {"a": round(a_epe, 5), "b": round(b_epe, 5),
+                          "rel_dev": round(epe_rel, 5)},
+            "a_epes": [round(x, 5) for x in a_epes],
+            "b_epes": [round(x, 5) for x in b_epes],
+        }
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fh:
+            json.dump(summary, fh, indent=1)
+        print(f"\nCHAOS FLOOR (torch vs torch, perturb {args.perturb:g}): "
+              f"final EPE a {a_epe:.4f} b {b_epe:.4f} "
+              f"rel {100*epe_rel:.2f}%  last-window loss rel "
+              f"{100*float(loss_rel):.2f}%", flush=True)
+        return 0
+
+    t_losses = torch_train(tmodel, "torch")
 
     # --- jax training loop (this framework's stack) -------------------------
     tcfg = TrainConfig(batch_size=b, train_iters=iters, lr=2e-4,
@@ -164,21 +236,16 @@ def main():
 
     # --- held-out EPE, each framework natively ------------------------------
     eh, ew = args.eval_size
-    tmodel.eval()
-    t_epes, j_epes = [], []
-    for i in range(args.eval_pairs):
-        i1, i2, d = make_pair(rng, eh, ew)
-        with torch.no_grad():
-            _, t_up = tmodel(torch.from_numpy(i1.transpose(2, 0, 1))[None],
-                             torch.from_numpy(i2.transpose(2, 0, 1))[None],
-                             iters=args.eval_iters, test_mode=True)
-        t_epes.append(float(np.mean(np.abs(-t_up.numpy()[0, 0] - d))))
+    pairs = [make_pair(rng, eh, ew) for _ in range(args.eval_pairs)]
+    t_epes = torch_eval(tmodel, pairs)
+    j_epes = []
+    for i, (i1, i2, d) in enumerate(pairs):
         _, j_up = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             jnp.asarray(i1)[None], jnp.asarray(i2)[None],
             iters=args.eval_iters, test_mode=True)
         j_epes.append(float(np.mean(np.abs(-np.asarray(j_up)[0, ..., 0] - d))))
-        print(f"eval pair {i}: torch EPE {t_epes[-1]:.4f} "
+        print(f"eval pair {i}: torch EPE {t_epes[i]:.4f} "
               f"jax EPE {j_epes[-1]:.4f}", flush=True)
 
     t_epe, j_epe = float(np.mean(t_epes)), float(np.mean(j_epes))
@@ -193,8 +260,13 @@ def main():
                  "pairs": args.eval_pairs},
         "torch_losses": [round(x, 5) for x in t_losses],
         "jax_losses": [round(x, 5) for x in j_losses],
-        "pass": bool(epe_rel <= args.tolerance
-                     and last_rel <= args.tolerance),
+        # The GATE is the last-window loss deviation: that is the training-
+        # dynamics criterion. Final EPE over a handful of pairs is dominated
+        # by chaotic trajectory divergence — judge it against the measured
+        # same-framework floor from --mode null (torch-vs-torch with a 1e-6
+        # init perturbation deviates 8.0% EPE / 3.4% loss at 300 steps,
+        # runs/parity_dynamics_null.json), not against a fixed tolerance.
+        "pass": bool(last_rel <= args.tolerance),
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as fh:
