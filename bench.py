@@ -26,12 +26,16 @@ banks a number before anything risky, with the full blocks-remat config
 BEST successful JSON even if other attempts fail.
 """
 
-import datetime
 import json
 import os
 import subprocess
 import sys
 import time
+
+# The dated JSON-line sink lives in obs/ (shared with run telemetry); the
+# re-export keeps the harnesses' `from bench import append_json_log` working.
+# obs.events is stdlib-only — the parent stays immune to a wedged jax import.
+from raft_stereo_tpu.obs.events import append_json_log  # noqa: F401
 
 BASELINE_PAIRS_PER_SEC_PER_CHIP = 20.0
 _RESULT_MARK = "BENCH_RESULT_JSON:"
@@ -335,17 +339,6 @@ def run_attempt_subprocess_detailed(kw, timeout_s=None, lock_wait_s=1800.0):
     return (None, f"rc={proc.returncode}: {tail}", time.monotonic() - t0)
 
 
-def append_json_log(path, entry):
-    """Dated JSON-line append shared by the measurement harnesses
-    (scripts/bank_monolith.py, scripts/batch_frontier.py): one logging
-    protocol, one copy."""
-    entry["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(entry) + "\n")
-    print(json.dumps(entry), flush=True)
-
-
 def _run_attempt_subprocess(kw, timeout_s=None):
     """run_chain's runner: result dict or None, errors to stderr."""
     result, err, _ = run_attempt_subprocess_detailed(kw, timeout_s)
@@ -396,8 +389,12 @@ def main():
     # own wall clock counts against the deadline.
     t_start = time.monotonic()
     on_tpu = _probe_on_tpu()
+    log_path = os.environ.get(
+        "BENCH_ATTEMPTS_LOG",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "runs", "bench", "attempts.jsonl"))
     best = run_chain(_attempt_chain(on_tpu), _run_attempt_subprocess,
-                     t_start=t_start)
+                     t_start=t_start, log_path=log_path)
     if best is None:
         print("all bench attempts failed", file=sys.stderr)
         return 1
@@ -405,34 +402,53 @@ def main():
     return 0
 
 
-def run_chain(attempts, runner, t_start=None, deadline_s=None):
+def run_chain(attempts, runner, t_start=None, deadline_s=None, log_path=None):
     """Drive the attempt chain: gate by ``when`` tier, keep the best result.
 
     Separated from main() so the gating policy — the part that decides
     whether the round reports a number at all — is unit-testable with a
     stubbed runner (tests/test_bench_chain.py).
+
+    ``log_path``: optional JSONL attempt log through the shared obs/ sink —
+    every attempt outcome (ok/failed/skipped/deadline) becomes a dated
+    record instead of a bespoke stderr print, so a round's history is a
+    machine-readable artifact (mirrored to stderr; stdout stays the parsed
+    result protocol).
     """
     if t_start is None:
         t_start = time.monotonic()
     if deadline_s is None:
         deadline_s = _DEADLINE_S
+
+    def log(entry):
+        if log_path:
+            append_json_log(log_path, entry, stream=sys.stderr)
+
     best = None
-    for att in attempts:
+    for i, att in enumerate(attempts):
+        base = {"attempt": i, "kw": att["kw"], "note": att.get("note"),
+                "when": att["when"]}
         if att["when"] == "unbanked" and best is not None:
+            log({**base, "status": "skipped", "reason": "already banked"})
             continue
         if (att["when"] == "below_par" and best is not None
                 and best["value"] >= _PAR_PAIRS_PER_SEC):
+            log({**base, "status": "skipped", "reason": "banked best at par"})
             continue
         if time.monotonic() - t_start > deadline_s:
             print("bench deadline reached; stopping the chain",
                   file=sys.stderr)
+            log({**base, "status": "deadline",
+                 "elapsed_s": round(time.monotonic() - t_start, 1)})
             break
         result = runner(att["kw"], att.get("timeout_s"))
         if result is None:
+            log({**base, "status": "failed"})
             continue
         if att.get("note"):
             result["note"] = att["note"]
         print(f"bench attempt ok: {result}", file=sys.stderr)
+        log({**base, "status": "ok", "result": result})
         if best is None or result["value"] > best["value"]:
             best = result
     return best

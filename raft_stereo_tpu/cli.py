@@ -122,6 +122,13 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                    help="width (sequence) parallel shards")
     o.add_argument("--grad_accum_steps", type=int, default=1,
                    help="average grads over k micro-batches per update")
+    o.add_argument("--run_dir", default="runs",
+                   help="run-artifact root: console/TB logs and the "
+                        "events.jsonl telemetry land under <run_dir>/<name>")
+    o.add_argument("--stall_deadline_s", type=float, default=300.0,
+                   help="stall-watchdog deadline: warn + emit a `stall` "
+                        "event when no step completes within this many "
+                        "seconds (0 disables)")
 
 
 def train_config(args: argparse.Namespace) -> TrainConfig:
@@ -150,6 +157,8 @@ def train_config(args: argparse.Namespace) -> TrainConfig:
         data_parallel=args.data_parallel,
         seq_parallel=args.seq_parallel,
         grad_accum_steps=args.grad_accum_steps,
+        run_dir=args.run_dir,
+        stall_deadline_s=args.stall_deadline_s or None,
     )
 
 
@@ -191,6 +200,9 @@ def build_eval_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description="RAFT-Stereo TPU evaluation")
     parser.add_argument("--restore_ckpt", default=None,
                         help="reference .pth or orbax state dir")
+    parser.add_argument("--run_dir", default=None,
+                        help="write events.jsonl telemetry (per-frame timing "
+                             "+ results) under this run directory")
     parser.add_argument("--dataset", required=True,
                         choices=["eth3d", "kitti", "things", "middlebury_F",
                                  "middlebury_H", "middlebury_Q"])
@@ -258,11 +270,60 @@ def _eval_main():
     _, variables = load_variables(args.restore_ckpt, cfg)
     predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
                                 bucket=args.bucket)
-    if args.dataset.startswith("middlebury_"):
-        results = validate_middlebury(predictor, args.data_root,
-                                      args.valid_iters,
-                                      split=args.dataset.split("_")[1])
-    else:
-        results = VALIDATORS[args.dataset](predictor, args.data_root,
-                                           args.valid_iters)
+    tel = None
+    if args.run_dir:
+        from raft_stereo_tpu.obs import Telemetry
+        tel = Telemetry(args.run_dir, stall_deadline_s=None)
+        tel.run_start(config={"dataset": args.dataset,
+                              "valid_iters": args.valid_iters})
+    try:
+        if args.dataset.startswith("middlebury_"):
+            results = validate_middlebury(predictor, args.data_root,
+                                          args.valid_iters,
+                                          split=args.dataset.split("_")[1],
+                                          telemetry=tel)
+        else:
+            results = VALIDATORS[args.dataset](predictor, args.data_root,
+                                               args.valid_iters,
+                                               telemetry=tel)
+    except BaseException as e:
+        if tel is not None:
+            tel.error(e)
+            tel.emit("run_end", steps=0, ok=False)
+            tel.close()
+        raise
+    if tel is not None:
+        tel.emit("run_end", steps=tel.steps, ok=True)
+        tel.close()
     print(results)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Subcommand dispatch for ``python -m raft_stereo_tpu.cli``:
+
+    * ``telemetry <run_dir>`` — summarize a run's events.jsonl + profiler
+      trace (obs/summarize.py),
+    * ``train`` / ``eval`` — the console entry points, for environments
+      without the installed scripts.
+    """
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    commands = ("telemetry", "train", "eval")
+    if not argv or argv[0] not in commands:
+        print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
+              "...", file=sys.stderr)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "telemetry":
+        from raft_stereo_tpu.obs.summarize import main as telemetry_main
+        return telemetry_main(rest)
+    # _train_main/_eval_main parse sys.argv via argparse; present the
+    # remainder as the whole command line
+    sys.argv = [f"{sys.argv[0]} {cmd}"] + rest
+    (_train_main if cmd == "train" else _eval_main)()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

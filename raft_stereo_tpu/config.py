@@ -222,6 +222,13 @@ class TrainConfig:
     # chips. num_steps counts micro-steps; the LR schedule advances per
     # accumulated update.
     grad_accum_steps: int = 1
+    # Observability (raft_stereo_tpu/obs): the run directory root — console/TB
+    # logs and the events.jsonl telemetry land under <run_dir>/<name> — and
+    # the stall-watchdog deadline: a `stall` event + console warning when no
+    # step completes within this many seconds (widened 10x before the first
+    # step to let initial compilation through). None/0 disables the watchdog.
+    run_dir: str = "runs"
+    stall_deadline_s: Optional[float] = 300.0
 
 
 # --- Named presets mirroring the reference's published training commands -------------

@@ -16,12 +16,18 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Iterator, Optional
+from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
 
 BATCH_FIELDS = ("image1", "image2", "flow", "valid")
+
+# Producer-side gauge cadence: one `loader` telemetry event per this many
+# batches (obs/telemetry.py loader_gauge) — frequent enough to see a draining
+# prefetch queue, cheap enough to never show up in a profile.
+GAUGE_EVERY = 16
 
 
 def _collate(samples) -> Dict[str, np.ndarray]:
@@ -62,6 +68,10 @@ class Loader:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.epoch = 0
+        # Optional telemetry hook (set by the trainer): called from the
+        # producer thread with queue-depth/wait gauges every GAUGE_EVERY
+        # batches. Must never raise into the pipeline — calls are guarded.
+        self.gauge_hook: Optional[Callable[[Dict], None]] = None
         # Consumed by the NEXT __iter__ only (then reset): resume support.
         # Because sample (epoch, index) fully determines decode + augment
         # (Philox keying below), skipping the first k batches of the
@@ -105,6 +115,7 @@ class Loader:
         stop = threading.Event()
 
         def produce():
+            decode_wait = put_wait = 0.0
             with ThreadPoolExecutor(self.num_workers) as pool:
                 # pipeline sample futures one batch ahead of consumption
                 futures = [pool.submit(self._sample, epoch, int(i))
@@ -120,13 +131,33 @@ class Loader:
                             self._sample, epoch, int(order[submitted])))
                         submitted += 1
                     try:
+                        t0 = time.perf_counter()
                         batch = _collate([f.result() for f in batch_futs])
+                        decode_wait += time.perf_counter() - t0
                     except Exception as e:  # propagate to consumer
                         out.put(e)
                         return
                     if stop.is_set():
                         return
+                    t0 = time.perf_counter()
                     out.put(batch)
+                    put_wait += time.perf_counter() - t0
+                    if self.gauge_hook is not None and b % GAUGE_EVERY == 0:
+                        try:
+                            # queue_depth: batches banked ahead of the
+                            # consumer (0 = training is data-starved);
+                            # put_wait_s: producer blocked on a full queue
+                            # (high = decode comfortably ahead)
+                            self.gauge_hook({
+                                "queue_depth": out.qsize(),
+                                "prefetch": self.prefetch,
+                                "decode_wait_s": round(decode_wait, 6),
+                                "put_wait_s": round(put_wait, 6),
+                                "batches_produced": b + 1,
+                                "epoch": epoch,
+                            })
+                        except Exception:
+                            self.gauge_hook = None  # never break the pipeline
                 out.put(None)
 
         thread = threading.Thread(target=produce, daemon=True)

@@ -52,8 +52,15 @@ def _predict(predictor: StereoPredictor, sample, iters: int):
     return flow_up[0]
 
 
+def _emit(telemetry, dataset: str, results: Dict[str, float]) -> None:
+    """Mirror a validator's results onto the telemetry bus (obs/) when the
+    caller runs one — eval CLI with --run_dir, or a future eval harness."""
+    if telemetry is not None:
+        telemetry.validation(results, dataset=dataset)
+
+
 def validate_eth3d(predictor: StereoPredictor, root: str = "datasets",
-                   iters: int = 32) -> Dict[str, float]:
+                   iters: int = 32, telemetry=None) -> Dict[str, float]:
     """ETH3D two-view validation: EPE + bad-1px (evaluate_stereo.py:19-56)."""
     ds = datasets.ETH3D(root=osp.join(root, "ETH3D"))
     if len(ds) == 0:
@@ -72,12 +79,15 @@ def validate_eth3d(predictor: StereoPredictor, root: str = "datasets",
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.mean(out_list))
     logger.info("Validation ETH3D: EPE %f, D1 %f", epe, d1)
-    return {"eth3d-epe": epe, "eth3d-d1": d1}
+    results = {"eth3d-epe": epe, "eth3d-d1": d1}
+    _emit(telemetry, "eth3d", results)
+    return results
 
 
 def validate_kitti(predictor: StereoPredictor, root: str = "datasets",
                    iters: int = 32,
-                   warmup_frames: int = 50) -> Dict[str, float]:
+                   warmup_frames: int = 50, telemetry=None
+                   ) -> Dict[str, float]:
     """KITTI-15 training-split validation: EPE + bad-3px + FPS
     (evaluate_stereo.py:59-108).
 
@@ -93,12 +103,18 @@ def validate_kitti(predictor: StereoPredictor, root: str = "datasets",
         raise ValueError(f"no samples found under {root!r}")
     epe_list, out_list, elapsed_dev, elapsed_e2e = [], [], [], []
     for i in range(len(ds)):
+        t_load = time.perf_counter()
         sample = ds.sample(i)
         t0 = time.perf_counter()
         flow_pr, dt_dev = predictor.predict_timed(
             sample["image1"][None], sample["image2"][None], iters)
         flow_pr = flow_pr[0]
         dt_e2e = time.perf_counter() - t0
+        if telemetry is not None:
+            # per-frame phase split: decode wait / device forward / the
+            # pad+transfer+fetch overhead around it
+            telemetry.step(i + 1, data_wait_s=t0 - t_load, dispatch_s=dt_dev,
+                           fetch_s=max(dt_e2e - dt_dev, 0.0), batch_size=1)
         if i > warmup_frames:
             elapsed_dev.append(dt_dev)
             elapsed_e2e.append(dt_e2e)
@@ -119,12 +135,14 @@ def validate_kitti(predictor: StereoPredictor, root: str = "datasets",
                     epe, d1, result["kitti-fps"], result["kitti-fps-e2e"])
     else:
         logger.info("Validation KITTI: EPE %f, D1 %f", epe, d1)
+    _emit(telemetry, "kitti", result)
     return result
 
 
 def validate_things(predictor: StereoPredictor, root: str = "datasets",
                     iters: int = 32,
-                    max_disp: float = 192.0) -> Dict[str, float]:
+                    max_disp: float = 192.0, telemetry=None
+                    ) -> Dict[str, float]:
     """FlyingThings3D TEST split: EPE + bad-1px over ``|disp| < max_disp``
     (evaluate_stereo.py:111-146). Doubles as the in-training validation hook
     (train_stereo.py:188)."""
@@ -145,12 +163,14 @@ def validate_things(predictor: StereoPredictor, root: str = "datasets",
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.concatenate(out_list).mean())
     logger.info("Validation FlyingThings: EPE %f, D1 %f", epe, d1)
-    return {"things-epe": epe, "things-d1": d1}
+    results = {"things-epe": epe, "things-d1": d1}
+    _emit(telemetry, "things", results)
+    return results
 
 
 def validate_middlebury(predictor: StereoPredictor, root: str = "datasets",
                         iters: int = 32,
-                        split: str = "F") -> Dict[str, float]:
+                        split: str = "F", telemetry=None) -> Dict[str, float]:
     """Middlebury MiddEval3 validation: EPE + bad-2px (evaluate_stereo.py:149-189).
 
     ``split`` in {'F','H','Q'}. Mask semantics replicate the reference
@@ -175,7 +195,9 @@ def validate_middlebury(predictor: StereoPredictor, root: str = "datasets",
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.mean(out_list))
     logger.info("Validation Middlebury%s: EPE %f, D1 %f", split, epe, d1)
-    return {f"middlebury{split}-epe": epe, f"middlebury{split}-d1": d1}
+    results = {f"middlebury{split}-epe": epe, f"middlebury{split}-d1": d1}
+    _emit(telemetry, f"middlebury{split}", results)
+    return results
 
 
 VALIDATORS = {

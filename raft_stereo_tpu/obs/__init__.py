@@ -1,0 +1,27 @@
+"""Run-scoped observability: JSONL event bus, stall watchdog, run summarizer.
+
+The three pieces every entry point shares:
+
+* :class:`Telemetry` (obs/telemetry.py) — the event bus; one instance per
+  run directory, writing schema-versioned records to
+  ``<run_dir>/events.jsonl``;
+* the schema + shared sink (obs/events.py) — :func:`append_json_log` is the
+  one dated JSON-line-append used by training telemetry, bench.py's attempt
+  log and the measurement harnesses;
+* the summarizer (obs/summarize.py) — ``python -m raft_stereo_tpu.cli
+  telemetry <run_dir>`` merges events.jsonl with a ``jax.profiler`` trace
+  into one report.
+"""
+
+from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
+                                        append_json_log, make_record,
+                                        read_events, validate_events,
+                                        validate_record)
+from raft_stereo_tpu.obs.telemetry import Telemetry
+from raft_stereo_tpu.obs.summarize import format_summary, summarize_run
+
+__all__ = [
+    "EVENT_TYPES", "SCHEMA_VERSION", "append_json_log", "make_record",
+    "read_events", "validate_events", "validate_record", "Telemetry",
+    "format_summary", "summarize_run",
+]

@@ -1,0 +1,127 @@
+"""Event schema + the shared JSONL sink.
+
+One record = one JSON object on one line. Every record carries:
+
+* ``schema`` — integer schema version (:data:`SCHEMA_VERSION`); bump it when
+  a record's required fields change so downstream summarizers fail loudly
+  instead of misreading old artifacts (scripts/check_events.py lints this),
+* ``ts`` — ISO-8601 wall-clock timestamp,
+* ``t`` — seconds since the run's telemetry was opened (monotonic clock;
+  the axis summarizers sort and window on, immune to NTP jumps),
+* ``event`` — one of :data:`EVENT_TYPES`' keys, plus that type's required
+  payload fields (extra fields are always allowed).
+
+The sink, :func:`append_json_log`, is the one copy of the dated
+JSON-line-append protocol used by ``runs/<name>/events.jsonl``, bench.py's
+attempt log and the measurement harnesses (scripts/bank_monolith.py,
+scripts/batch_frontier.py). It creates parent directories — including the
+degenerate "bare filename" case whose empty dirname used to crash the
+bench.py copy — and mirrors each line to a stream for live consumption.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional
+
+SCHEMA_VERSION = 1
+
+# event type -> payload fields REQUIRED at this schema version. Extra fields
+# are fine; missing ones are schema drift (caught by validate_record and the
+# scripts/check_events.py lint).
+EVENT_TYPES: Dict[str, tuple] = {
+    "run_start": ("run",),
+    # Step timing split by phase (seconds): host wait on the data pipeline,
+    # device dispatch (the jitted call; synchronous compile lands here on
+    # first execution), and the host fetch of executable outputs — the real
+    # device-completion sync point on tunneled TPUs (see bench.py).
+    "step": ("step", "data_wait_s", "dispatch_s", "fetch_s"),
+    "compile": ("duration_s", "source"),
+    "checkpoint": ("step", "path"),
+    "validation": ("results",),
+    "throughput": ("pairs_per_sec", "steps"),
+    "memory": ("stats",),
+    "loader": ("queue_depth",),
+    "stall": ("seconds_since_step", "deadline_s"),
+    "error": ("error",),
+    "run_end": ("steps",),
+}
+
+
+def make_record(event: str, t: Optional[float] = None,
+                **payload: Any) -> Dict[str, Any]:
+    """Build a schema-stamped record (validation is the writer's job)."""
+    rec: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "ts": datetime.datetime.now().isoformat(timespec="milliseconds"),
+        "event": event,
+    }
+    if t is not None:
+        rec["t"] = round(float(t), 6)
+    rec.update(payload)
+    return rec
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Return a list of schema violations (empty = valid)."""
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    errors: List[str] = []
+    if rec.get("schema") != SCHEMA_VERSION:
+        errors.append(f"schema {rec.get('schema')!r} != {SCHEMA_VERSION}")
+    if not isinstance(rec.get("ts"), str):
+        errors.append("missing/non-string ts")
+    event = rec.get("event")
+    if event not in EVENT_TYPES:
+        errors.append(f"unknown event {event!r}")
+        return errors
+    for field in EVENT_TYPES[event]:
+        if field not in rec:
+            errors.append(f"{event}: missing required field {field!r}")
+    return errors
+
+
+def append_json_log(path: str, entry: Dict[str, Any],
+                    stream=sys.stdout) -> Dict[str, Any]:
+    """Dated JSON-line append; returns the entry (with ``ts`` stamped).
+
+    ``stream`` mirrors the line for live consumption (pass ``sys.stderr`` —
+    or ``None`` to silence — where stdout is a parsed protocol, e.g.
+    bench.py's attempt chain).
+    """
+    entry = dict(entry)
+    entry.setdefault(
+        "ts", datetime.datetime.now().isoformat(timespec="seconds"))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    line = json.dumps(entry)
+    with open(path, "a") as f:
+        f.write(line + "\n")
+    if stream is not None:
+        print(line, file=stream, flush=True)
+    return entry
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse an events.jsonl; raises ValueError on unparseable lines."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{lineno}: unparseable record: {e}")
+    return out
+
+
+def validate_events(records: Iterable[Dict[str, Any]]) -> List[str]:
+    """Validate a record stream; returns ["#<idx>: <violation>", ...]."""
+    errors: List[str] = []
+    for i, rec in enumerate(records):
+        errors.extend(f"#{i}: {e}" for e in validate_record(rec))
+    return errors

@@ -1,0 +1,205 @@
+"""Run-report builder: events.jsonl + (optional) profiler trace, one summary.
+
+``python -m raft_stereo_tpu.cli telemetry <run_dir>`` lands here. The report
+merges the two observability artifacts a run can leave behind:
+
+* ``<run_dir>/events.jsonl`` (obs/telemetry.py) — per-phase step timing
+  percentiles, throughput trend over step windows, compile count/time,
+  checkpoints, validations, stalls and errors;
+* a ``jax.profiler`` trace under the run dir (``plugins/profile/...``) —
+  device-op/category totals via :func:`utils.profiling.summarize_trace`.
+
+Either half may be absent; the report says so instead of failing, because
+the summarizer's job is reading partial artifacts from wedged runs.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from raft_stereo_tpu.obs.events import read_events, validate_events
+
+_PHASES = ("data_wait_s", "dispatch_s", "fetch_s")
+
+
+def _percentiles(values: Sequence[float]) -> Dict[str, float]:
+    import numpy as np
+    arr = np.asarray(sorted(values), dtype=np.float64)
+    return {
+        "p50": float(np.percentile(arr, 50)),
+        "p90": float(np.percentile(arr, 90)),
+        "max": float(arr[-1]),
+        "total": float(arr.sum()),
+    }
+
+
+def _throughput_trend(steps: List[Dict[str, Any]],
+                      n_windows: int = 5) -> List[Dict[str, Any]]:
+    """Pairs/sec per window of consecutive step records (wall time from the
+    monotonic ``t`` axis; falls back to per-phase sums when ``t`` is absent)."""
+    timed = [s for s in steps if "batch_size" in s]
+    if len(timed) < 2:
+        return []
+    per = max(len(timed) // n_windows, 1)
+    trend = []
+    for i in range(0, len(timed), per):
+        win = timed[i:i + per]
+        if len(win) >= 2 and all("t" in s for s in win):
+            dt = win[-1]["t"] - win[0]["t"]
+            pairs = sum(s["batch_size"] for s in win[1:])
+        else:
+            dt = sum(sum(s.get(p, 0.0) for p in _PHASES) for s in win)
+            pairs = sum(s["batch_size"] for s in win)
+        if dt > 0:
+            trend.append({
+                "steps": [win[0].get("step"), win[-1].get("step")],
+                "pairs_per_sec": round(pairs / dt, 3),
+            })
+    return trend
+
+
+def _find_trace_dir(run_dir: str) -> Optional[str]:
+    hits = glob.glob(os.path.join(run_dir, "**", "plugins", "profile"),
+                     recursive=True)
+    if not hits:
+        return None
+    # summarize_trace expects the log dir CONTAINING plugins/profile
+    return os.path.dirname(os.path.dirname(sorted(hits)[0]))
+
+
+def summarize_run(run_dir: str, top: int = 10) -> Dict[str, Any]:
+    """Build the merged report dict for ``run_dir``."""
+    report: Dict[str, Any] = {"run_dir": run_dir}
+
+    events_path = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(events_path):
+        events = read_events(events_path)
+        report["events"] = _summarize_events(events)
+        report["schema_errors"] = validate_events(events)[:20]
+    else:
+        report["events"] = None
+
+    trace_dir = _find_trace_dir(run_dir)
+    if trace_dir is not None:
+        from raft_stereo_tpu.utils.profiling import summarize_trace
+        try:
+            report["trace"] = summarize_trace(trace_dir, top=top)
+        except Exception as e:  # partial/corrupt capture from a wedged run
+            report["trace"] = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        report["trace"] = None
+    return report
+
+
+def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    by = lambda kind: [e for e in events if e.get("event") == kind]  # noqa: E731
+    steps = by("step")
+    out: Dict[str, Any] = {
+        "n_records": len(events),
+        "run": next((e.get("run") for e in by("run_start")), None),
+        "steps": len(steps),
+        "phases": {p: _percentiles([s[p] for s in steps if p in s])
+                   for p in _PHASES if any(p in s for s in steps)},
+        "throughput_trend": _throughput_trend(steps),
+        "compiles": {
+            "count": len(by("compile")),
+            "total_s": round(sum(e.get("duration_s", 0.0)
+                                 for e in by("compile")), 3),
+        },
+        "checkpoints": [{"step": e.get("step"), "path": e.get("path")}
+                        for e in by("checkpoint")],
+        "validations": [e.get("results") for e in by("validation")],
+        "stalls": [{"t": e.get("t"),
+                    "seconds_since_step": e.get("seconds_since_step"),
+                    "deadline_s": e.get("deadline_s")}
+                   for e in by("stall")],
+        "errors": [e.get("error") for e in by("error")],
+    }
+    ends = by("run_end")
+    if ends:
+        out["run_end"] = {k: ends[-1].get(k) for k in ("steps", "ok", "t")}
+    mems = [e for e in by("memory") if e.get("stats")]
+    if mems:
+        last = mems[-1]["stats"]
+        out["memory_last"] = {k: last[k] for k in
+                              ("bytes_in_use", "peak_bytes_in_use")
+                              if k in last}
+    return out
+
+
+def format_summary(report: Dict[str, Any]) -> str:
+    lines: List[str] = [f"run: {report['run_dir']}"]
+    ev = report.get("events")
+    if ev is None:
+        lines.append("events: none (no events.jsonl under the run dir)")
+    else:
+        lines.append(f"events: {ev['n_records']} records, "
+                     f"{ev['steps']} steps"
+                     + (f" (run '{ev['run']}')" if ev.get("run") else ""))
+        end = ev.get("run_end")
+        if end:
+            lines.append(f"run_end: ok={end.get('ok')} "
+                         f"steps={end.get('steps')} at t={end.get('t')}s")
+        if ev["phases"]:
+            lines.append("")
+            lines.append("per-step phases (s):"
+                         "          p50       p90       max     total")
+            for p, q in ev["phases"].items():
+                lines.append(f"  {p:16s} {q['p50']:12.4f} {q['p90']:9.4f} "
+                             f"{q['max']:9.4f} {q['total']:9.2f}")
+        if ev["throughput_trend"]:
+            lines.append("")
+            lines.append("throughput trend (pairs/sec):")
+            for w in ev["throughput_trend"]:
+                lines.append(f"  steps {w['steps'][0]}-{w['steps'][1]}: "
+                             f"{w['pairs_per_sec']}")
+        c = ev["compiles"]
+        lines.append("")
+        lines.append(f"compiles: {c['count']} ({c['total_s']} s)")
+        lines.append(f"checkpoints: {len(ev['checkpoints'])}"
+                     + ("".join(f"\n  step {k['step']}: {k['path']}"
+                                for k in ev["checkpoints"][-3:])))
+        for v in ev["validations"]:
+            lines.append(f"validation: {v}")
+        if "memory_last" in ev:
+            lines.append(f"device memory (last): {ev['memory_last']}")
+        if ev["stalls"]:
+            lines.append(f"STALLS: {len(ev['stalls'])}")
+            for s in ev["stalls"]:
+                lines.append(f"  t={s['t']}s: no step for "
+                             f"{s['seconds_since_step']}s "
+                             f"(deadline {s['deadline_s']}s)")
+        else:
+            lines.append("stalls: none")
+        for e in ev["errors"]:
+            lines.append(f"ERROR: {e}")
+        if ev.get("schema_errors") or report.get("schema_errors"):
+            for e in report.get("schema_errors", []):
+                lines.append(f"schema violation: {e}")
+
+    tr = report.get("trace")
+    lines.append("")
+    if tr is None:
+        lines.append("trace: none (no jax.profiler capture under the run dir)")
+    elif "error" in tr:
+        lines.append(f"trace: unreadable ({tr['error']})")
+    else:
+        from raft_stereo_tpu.utils.profiling import format_report
+        lines.append("profiler trace:")
+        lines.append(format_report(tr))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Summarize a run directory's telemetry "
+                    "(events.jsonl + optional profiler trace)")
+    p.add_argument("run_dir")
+    p.add_argument("--top", type=int, default=10,
+                   help="top device ops to show from the trace")
+    args = p.parse_args(argv)
+    print(format_summary(summarize_run(args.run_dir, top=args.top)))
+    return 0

@@ -1,0 +1,262 @@
+"""The run-scoped telemetry bus: events.jsonl writer + stall watchdog.
+
+A :class:`Telemetry` instance owns one run directory and appends
+schema-stamped records (obs/events.py) to ``<run_dir>/events.jsonl``. It is
+thread-safe (the loader's producer thread and the watchdog emit concurrently
+with the training loop) and fail-open: a telemetry bug must never take down
+the run it observes, so emit errors are logged once and swallowed.
+
+Three observers ride on the bus:
+
+* **Compile hook** — ``jax.monitoring`` duration events whose key mentions
+  compilation are forwarded as ``compile`` records. Registered once per
+  process (listeners cannot be unregistered in current JAX) and dispatched
+  to whichever instances are open. First-call latency is the complementary
+  detector: the trainer stamps its first step's dispatch time as a
+  ``compile`` record with ``source="first_step_latency"`` — on tunneled
+  remote-compile setups the helper's time is invisible to jax.monitoring.
+* **Stall watchdog** — a daemon thread that emits a ``stall`` record and a
+  one-line console warning when no heartbeat (= completed step) lands within
+  ``stall_deadline_s`` (the tunneled-TPU failure mode PERF.md documents).
+  One warning per stall episode; a new heartbeat re-arms it. Before the
+  first step the deadline is widened 10x: initial compilation legitimately
+  takes minutes.
+* **Device memory** — ``memory`` records via
+  ``jax.local_devices()[0].memory_stats()`` where the backend provides it
+  (TPU does; CPU returns nothing and the record carries ``stats: {}``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from raft_stereo_tpu.obs.events import make_record, append_json_log
+
+logger = logging.getLogger(__name__)
+
+# Compile-episode deadline widening before the first heartbeat (see module
+# doc); tests override via the Telemetry(first_step_grace=) knob.
+_FIRST_STEP_GRACE = 10.0
+
+# --- process-global compile-hook dispatch ----------------------------------
+_hook_lock = threading.Lock()
+_hook_registered = False
+_active_instances: "set[Telemetry]" = set()
+
+
+def _compile_listener(event: str, duration: float, **_kw) -> None:
+    # Only true backend compilations (plus anything compile-flavored that
+    # took real time): jax traces EVERY jaxpr through this channel — a tiny
+    # train run emits 1000+ sub-millisecond jaxpr_trace records otherwise.
+    if "backend_compile" not in event and not (
+            "compil" in event and duration >= 0.5):
+        return
+    for tel in list(_active_instances):
+        tel._emit_compile(event, duration)
+
+
+def _ensure_compile_hook() -> bool:
+    global _hook_registered
+    with _hook_lock:
+        if _hook_registered:
+            return True
+        try:
+            import jax.monitoring
+            jax.monitoring.register_event_duration_secs_listener(
+                _compile_listener)
+            _hook_registered = True
+        except Exception:  # jax absent / API moved: first-call latency only
+            return False
+    return True
+
+
+class Telemetry:
+    """Event bus for one run directory; safe to use as a context manager
+    (exceptions inside the ``with`` are recorded as ``error`` events and
+    re-raised)."""
+
+    def __init__(self, run_dir: str, run_name: Optional[str] = None,
+                 stall_deadline_s: Optional[float] = None,
+                 first_step_grace: float = _FIRST_STEP_GRACE,
+                 watch_interval_s: Optional[float] = None):
+        self.run_dir = run_dir
+        self.run_name = run_name or os.path.basename(
+            os.path.normpath(run_dir)) or "run"
+        self.events_path = os.path.join(run_dir, "events.jsonl")
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._emit_failed = False
+        # step bookkeeping (heartbeat + throughput windows)
+        self._steps = 0
+        self._last_beat = self._t0
+        self._window_pairs = 0
+        self._window_t0 = self._t0
+        self._compile_s = 0.0
+        # stall watchdog
+        self._deadline = stall_deadline_s
+        self._grace = max(first_step_grace, 1.0)
+        self._stalled = False
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        os.makedirs(run_dir, exist_ok=True)
+        _active_instances.add(self)
+        _ensure_compile_hook()
+        if stall_deadline_s and stall_deadline_s > 0:
+            interval = watch_interval_s or min(
+                max(stall_deadline_s / 4.0, 0.05), 10.0)
+            self._watchdog = threading.Thread(
+                target=self._watch, args=(interval,),
+                name="telemetry-watchdog", daemon=True)
+            self._watchdog.start()
+
+    # --- core ---------------------------------------------------------------
+
+    def emit(self, event: str, **payload: Any) -> None:
+        """Append one record; never raises (fail-open, logged once)."""
+        rec = make_record(event, t=time.monotonic() - self._t0, **payload)
+        try:
+            with self._lock:
+                if self._closed:
+                    return
+                append_json_log(self.events_path, rec, stream=None)
+        except Exception:
+            if not self._emit_failed:
+                self._emit_failed = True
+                logger.exception("telemetry emit failed (disabled for run)")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+        _active_instances.discard(self)
+        with self._lock:
+            self._closed = True
+
+    def __enter__(self) -> "Telemetry":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.error(exc)
+        self.emit("run_end", steps=self._steps,
+                  ok=exc is None, compile_s=round(self._compile_s, 3))
+        self.close()
+
+    @property
+    def steps(self) -> int:
+        """Heartbeats (completed steps) observed by this instance."""
+        return self._steps
+
+    # --- record helpers -----------------------------------------------------
+
+    def run_start(self, config: Optional[Dict[str, Any]] = None,
+                  **payload: Any) -> None:
+        payload.setdefault("devices", _device_info())
+        self.emit("run_start", run=self.run_name,
+                  config=config or {}, **payload)
+
+    def step(self, step: int, data_wait_s: float, dispatch_s: float,
+             fetch_s: float, batch_size: Optional[int] = None,
+             **payload: Any) -> None:
+        """One completed training/eval step; doubles as the heartbeat."""
+        if batch_size is not None:
+            payload["batch_size"] = batch_size
+            self._window_pairs += batch_size
+        self.emit("step", step=int(step),
+                  data_wait_s=round(data_wait_s, 6),
+                  dispatch_s=round(dispatch_s, 6),
+                  fetch_s=round(fetch_s, 6), **payload)
+        self.heartbeat()
+
+    def heartbeat(self) -> None:
+        self._steps += 1
+        self._last_beat = time.monotonic()
+        self._stalled = False
+
+    def checkpoint(self, step: int, path: str) -> None:
+        self.emit("checkpoint", step=int(step), path=path)
+        self.memory()
+
+    def validation(self, results: Dict[str, float],
+                   dataset: Optional[str] = None) -> None:
+        payload = {"dataset": dataset} if dataset else {}
+        self.emit("validation",
+                  results={k: float(v) for k, v in results.items()},
+                  **payload)
+
+    def throughput(self, pairs_per_sec: float, steps: int,
+                   **payload: Any) -> None:
+        self.emit("throughput", pairs_per_sec=round(pairs_per_sec, 4),
+                  steps=int(steps), **payload)
+
+    def window_throughput(self) -> Optional[float]:
+        """Pairs/sec since the last call (or run start); emits a
+        ``throughput`` record and resets the window. None when no batch-sized
+        steps landed in the window."""
+        now = time.monotonic()
+        pairs, dt = self._window_pairs, now - self._window_t0
+        self._window_pairs, self._window_t0 = 0, now
+        if pairs == 0 or dt <= 0:
+            return None
+        pps = pairs / dt
+        self.throughput(pps, steps=self._steps, window_s=round(dt, 3))
+        return pps
+
+    def memory(self) -> None:
+        self.emit("memory", stats=_memory_stats())
+
+    def loader_gauge(self, gauges: Dict[str, Any]) -> None:
+        """Queue-depth/wait gauges from the data pipeline's producer thread."""
+        self.emit("loader", **gauges)
+
+    def error(self, exc: BaseException) -> None:
+        self.emit("error", error=f"{type(exc).__name__}: {exc}",
+                  traceback="".join(traceback.format_exception(
+                      type(exc), exc, exc.__traceback__))[-4000:])
+
+    def _emit_compile(self, source: str, duration: float) -> None:
+        self._compile_s += duration
+        self.emit("compile", duration_s=round(duration, 3), source=source)
+
+    # --- watchdog -----------------------------------------------------------
+
+    def _watch(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            deadline = self._deadline
+            if deadline is None:
+                continue
+            if self._steps == 0:
+                deadline = deadline * self._grace
+            elapsed = time.monotonic() - self._last_beat
+            if elapsed > deadline and not self._stalled:
+                self._stalled = True  # one record per episode
+                logger.warning(
+                    "STALL: no step completed in %.1fs (deadline %.1fs) — "
+                    "run %s may be wedged (tunneled-TPU stall? see PERF.md); "
+                    "details in %s", elapsed, deadline, self.run_name,
+                    self.events_path)
+                self.emit("stall", seconds_since_step=round(elapsed, 3),
+                          deadline_s=deadline, steps=self._steps)
+
+
+def _device_info() -> Dict[str, Any]:
+    try:
+        import jax
+        devs = jax.local_devices()
+        return {"platform": devs[0].platform, "count": len(devs)}
+    except Exception:
+        return {}
+
+
+def _memory_stats() -> Dict[str, Any]:
+    try:
+        import jax
+        return dict(jax.local_devices()[0].memory_stats() or {})
+    except Exception:
+        return {}

@@ -1,0 +1,21 @@
+"""shard_map across JAX versions.
+
+The framework targets the stable ``jax.shard_map`` (jax >= 0.7, ``check_vma``
+kwarg), but CI/sandbox images sometimes pin an older jax where the API lives
+at ``jax.experimental.shard_map`` and the replication-check kwarg is named
+``check_rep``. This shim exports one ``shard_map`` accepting the modern
+surface so every parallel module (and everything importing them — trainer,
+telemetry smoke tests) stays importable on both.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.7: the stable API, used as-is
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental namespace + check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(*args, check_vma: bool = True, **kwargs):
+        return _shard_map(*args, check_rep=check_vma, **kwargs)
+
+__all__ = ["shard_map"]

@@ -28,8 +28,8 @@ from typing import Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from raft_stereo_tpu.parallel.compat import shard_map
 from raft_stereo_tpu.parallel.mesh import (
     DATA_AXIS,
     SEQ_AXIS,

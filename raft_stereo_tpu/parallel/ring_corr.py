@@ -35,8 +35,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from raft_stereo_tpu.parallel.compat import shard_map
 from raft_stereo_tpu.ops.geometry import pool_w2
 from raft_stereo_tpu.ops.sampler import windowed_linear_sample
 from raft_stereo_tpu.parallel.mesh import SEQ_AXIS

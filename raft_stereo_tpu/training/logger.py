@@ -29,11 +29,18 @@ def _make_writer(log_dir: str):
 
 
 class Logger:
-    def __init__(self, log_dir: str = "runs", total_steps: int = 0):
+    """The reference Logger surface, optionally mirrored onto the telemetry
+    bus: pass ``telemetry`` (an :class:`raft_stereo_tpu.obs.Telemetry`) and
+    validation dicts become ``validation`` events while console/TB behavior
+    stays byte-identical."""
+
+    def __init__(self, log_dir: str = "runs", total_steps: int = 0,
+                 telemetry=None):
         self.total_steps = total_steps
         self.running: Dict[str, float] = {}
         self.window = 0  # pushes since last flush (may be < SUM_FREQ on resume)
         self.writer = _make_writer(log_dir)
+        self.telemetry = telemetry
 
     def _flush(self, lr: float):
         keys = sorted(self.running)
@@ -66,6 +73,8 @@ class Logger:
         if self.writer is not None:
             for k, v in results.items():
                 self.writer.add_scalar(k, float(v), self.total_steps)
+        if self.telemetry is not None:
+            self.telemetry.validation(results)
 
     def close(self):
         if self.writer is not None:

@@ -22,10 +22,13 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+import dataclasses
+
 from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
 from raft_stereo_tpu.data.datasets import fetch_dataloader
 from raft_stereo_tpu.data.loader import infinite_batches
 from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.obs import Telemetry
 from raft_stereo_tpu.parallel.data_parallel import make_pjit_train_step
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicated, shard_batch
 from raft_stereo_tpu.training.checkpoint import (restore_train_state,
@@ -91,59 +94,96 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
     # the exact schedule fetch_optimizer applies (shared, cannot desync)
     schedule = fetch_schedule(cfg)
 
+    run_dir = os.path.join(cfg.run_dir, cfg.name)
+    tel = Telemetry(run_dir, run_name=cfg.name,
+                    stall_deadline_s=cfg.stall_deadline_s)
+    tel.run_start(config={"model": dataclasses.asdict(model_cfg),
+                          "train": dataclasses.asdict(cfg)},
+                  n_params=int(n_params), resumed_step=int(state.step))
+    loader.gauge_hook = tel.loader_gauge
+
     with mesh:
         state = jax.device_put(state, replicated(mesh))
         step_fn = make_pjit_train_step(model, tx, cfg.train_iters, mesh)
 
-        log = Logger(total_steps=int(state.step))
+        # console/TB logging rides the run dir telemetry owns; write_dict
+        # mirrors validation results onto the event bus
+        log = Logger(log_dir=run_dir, total_steps=int(state.step),
+                     telemetry=tel)
         validation_predictor = None  # built lazily, reused across validations
-        t_start, imgs_done = time.perf_counter(), 0
-        global_step = int(state.step)
+        global_step = start_step = int(state.step)
         pending = None  # lagged metrics fetch: sync step i-1 while i runs
-        for batch in infinite_batches(loader):
-            if global_step >= cfg.num_steps:
-                break
-            placed = shard_batch(mesh, batch)
-            state, metrics = step_fn(state, placed)
-            if pending is not None:
-                log.push({k: float(v) for k, v in pending.items()},
-                         lr=float(schedule((global_step - 1) // accum_k)))
-            pending = metrics
-            imgs_done += cfg.batch_size
-            global_step += 1
-
-            if global_step % validation_frequency == 0:
-                # flush the in-flight metrics first so validation scalars and
-                # the checkpoint agree on the step axis
+        batches = infinite_batches(loader)
+        try:
+            while global_step < cfg.num_steps:
+                t0 = time.perf_counter()
+                batch = next(batches)
+                t1 = time.perf_counter()
+                placed = shard_batch(mesh, batch)
+                state, metrics = step_fn(state, placed)
+                t2 = time.perf_counter()
                 if pending is not None:
                     log.push({k: float(v) for k, v in pending.items()},
                              lr=float(schedule((global_step - 1) // accum_k)))
-                    pending = None
-                ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
-                                        step=global_step)
-                logger.info("saved %s", ckpt)
-                variables_host = jax.device_get(state.variables)
-                if validation_predictor is None:
-                    from raft_stereo_tpu.inference import StereoPredictor
-                    validation_predictor = StereoPredictor(
-                        model_cfg, variables_host,
-                        valid_iters=cfg.valid_iters)
-                else:  # keep the jit cache, refresh only the weights
-                    validation_predictor.variables = variables_host
-                results = _maybe_validate_things(validation_predictor, cfg)
-                if results:
-                    log.write_dict(results)
-                dt = time.perf_counter() - t_start
-                logger.info("throughput: %.2f pairs/sec over last window",
-                            imgs_done / max(dt, 1e-9))
-                t_start, imgs_done = time.perf_counter(), 0
+                t3 = time.perf_counter()
+                pending = metrics
+                global_step += 1
+                if global_step == start_step + 1:
+                    # first-call latency: the pjit dispatch above compiled
+                    # synchronously (remote-helper time included — invisible
+                    # to the jax.monitoring compile hook)
+                    tel.emit("compile", duration_s=round(t2 - t1, 3),
+                             source="first_step_latency")
+                tel.step(global_step, data_wait_s=t1 - t0,
+                         dispatch_s=t2 - t1, fetch_s=t3 - t2,
+                         batch_size=cfg.batch_size)
 
-        if pending is not None:
-            log.push({k: float(v) for k, v in pending.items()},
-                     lr=float(schedule((global_step - 1) // accum_k)))
-        final = save_train_state(cfg.ckpt_dir, cfg.name, state)
-        log.close()
-    logger.info("training done: %s", final)
+                if global_step % validation_frequency == 0:
+                    # flush the in-flight metrics first so validation scalars
+                    # and the checkpoint agree on the step axis
+                    if pending is not None:
+                        log.push(
+                            {k: float(v) for k, v in pending.items()},
+                            lr=float(schedule((global_step - 1) // accum_k)))
+                        pending = None
+                    ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
+                                            step=global_step)
+                    logger.info("saved %s", ckpt)
+                    tel.checkpoint(global_step, ckpt)
+                    variables_host = jax.device_get(state.variables)
+                    if validation_predictor is None:
+                        from raft_stereo_tpu.inference import StereoPredictor
+                        validation_predictor = StereoPredictor(
+                            model_cfg, variables_host,
+                            valid_iters=cfg.valid_iters)
+                    else:  # keep the jit cache, refresh only the weights
+                        validation_predictor.variables = variables_host
+                    results = _maybe_validate_things(validation_predictor, cfg)
+                    if results:
+                        log.write_dict(results)
+                    pps = tel.window_throughput()
+                    if pps is not None:
+                        logger.info(
+                            "throughput: %.2f pairs/sec over last window", pps)
+
+            if pending is not None:
+                log.push({k: float(v) for k, v in pending.items()},
+                         lr=float(schedule((global_step - 1) // accum_k)))
+            final = save_train_state(cfg.ckpt_dir, cfg.name, state)
+            tel.checkpoint(global_step, final)
+        except BaseException as e:
+            tel.error(e)
+            tel.emit("run_end", steps=global_step - start_step, ok=False,
+                     step=global_step)
+            tel.close()
+            raise
+        finally:
+            log.close()
+    tel.window_throughput()
+    tel.emit("run_end", steps=global_step - start_step, ok=True,
+             step=global_step)
+    tel.close()
+    logger.info("training done: %s (telemetry: %s)", final, tel.events_path)
     return final
 
 

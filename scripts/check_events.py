@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Schema lint for events.jsonl artifacts (obs/events.py).
+
+Validates every record of one or more ``events.jsonl`` files (or run
+directories containing one) against the current ``SCHEMA_VERSION`` and each
+event type's required fields, and exits non-zero on any violation — wired
+into the tier-1 run via tests/test_telemetry.py so schema drift fails tests
+instead of silently corrupting downstream summarizers.
+
+Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_stereo_tpu.obs.events import read_events, validate_events  # noqa: E402
+
+
+def check(path: str) -> list:
+    """Return ["<path>: <violation>", ...] for one file or run dir."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        return [f"{path}: missing"]
+    try:
+        records = read_events(path)
+    except ValueError as e:
+        return [str(e)]
+    if not records:
+        return [f"{path}: empty event log"]
+    return [f"{path}: {e}" for e in validate_events(records)]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    errors = []
+    for path in argv:
+        errors.extend(check(path))
+    for e in errors:
+        print(e, file=sys.stderr)
+    if not errors:
+        print(f"ok: {len(argv)} artifact(s) conform to the event schema")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

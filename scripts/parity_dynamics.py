@@ -135,8 +135,16 @@ def main():
             flow_gt = torch.from_numpy(f.transpose(0, 3, 1, 2))
             opt.zero_grad()
             preds = model_(im1, im2, iters=iters)
+            # The reference sequence_loss masking (train_stereo.py:43-46):
+            # valid pixels with |gt flow| < max_flow=700, per-iteration mean
+            # over MASKED pixels only — the same normalization our jax
+            # sequence_loss applies, so the trajectories being compared run
+            # the same loss even if a synthetic pair ever exceeds max_flow.
+            # (The generator has no invalid pixels, so valid is all-ones.)
+            mask = (flow_gt.abs() < 700.0).float()
+            denom = mask.sum().clamp(min=1.0)
             loss = sum((gamma_adj ** (len(preds) - 1 - i)) *
-                       (pr[:, :1] - flow_gt).abs().mean()
+                       ((pr[:, :1] - flow_gt).abs() * mask).sum() / denom
                        for i, pr in enumerate(preds))
             loss.backward()
             torch.nn.utils.clip_grad_norm_(model_.parameters(), 1.0)

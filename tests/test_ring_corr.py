@@ -6,6 +6,11 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+# Every case traces shard_map collectives through the full lookup (several
+# compile-heavy 8-device XLA-CPU sessions — minutes of wall clock), so the
+# module runs in the slow tier with the end-to-end train loop.
+pytestmark = pytest.mark.slow
+
 from raft_stereo_tpu.ops.corr import corr_lookup, init_corr
 from raft_stereo_tpu.ops.geometry import coords_grid
 from raft_stereo_tpu.parallel.mesh import make_mesh

@@ -1,0 +1,226 @@
+"""Observability layer (raft_stereo_tpu/obs): schema round-trip, the shared
+JSONL sink, the stall watchdog, the run summarizer and the schema lint."""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from raft_stereo_tpu.obs import (SCHEMA_VERSION, Telemetry, append_json_log,
+                                 format_summary, make_record, read_events,
+                                 summarize_run, validate_events,
+                                 validate_record)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _write_run(run_dir, steps=6, stall_deadline_s=None, **tel_kw):
+    """A synthetic but schema-complete run: one of every record family."""
+    tel = Telemetry(str(run_dir), run_name="synth",
+                    stall_deadline_s=stall_deadline_s, **tel_kw)
+    tel.run_start(config={"batch_size": 2})
+    tel.emit("compile", duration_s=1.25, source="first_step_latency")
+    for i in range(steps):
+        tel.step(i + 1, data_wait_s=0.01 * (i + 1), dispatch_s=0.05,
+                 fetch_s=0.002, batch_size=2, loss=3.0 - 0.1 * i)
+    tel.loader_gauge({"queue_depth": 3, "put_wait_s": 0.1,
+                      "batches_produced": steps, "epoch": 0})
+    tel.checkpoint(steps, str(run_dir / "ckpt"))
+    tel.validation({"things-epe": 1.5}, dataset="things")
+    tel.window_throughput()
+    tel.emit("run_end", steps=steps, ok=True)
+    tel.close()
+    return tel
+
+
+# --- schema -----------------------------------------------------------------
+
+def test_events_schema_roundtrip(tmp_path):
+    _write_run(tmp_path / "run")
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    assert validate_events(events) == []
+    kinds = {e["event"] for e in events}
+    assert {"run_start", "step", "compile", "checkpoint", "validation",
+            "loader", "throughput", "memory", "run_end"} <= kinds
+    assert all(e["schema"] == SCHEMA_VERSION for e in events)
+    # the monotonic axis is present and non-decreasing
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_validate_record_catches_drift():
+    good = make_record("step", step=1, data_wait_s=0.0, dispatch_s=0.1,
+                       fetch_s=0.0)
+    assert validate_record(good) == []
+    assert validate_record({**good, "schema": SCHEMA_VERSION + 1})
+    assert validate_record({k: v for k, v in good.items()
+                            if k != "dispatch_s"})
+    assert validate_record({**good, "event": "not-an-event"})
+    assert validate_record("not a dict")
+
+
+def test_append_json_log_bare_filename(tmp_path, monkeypatch):
+    # regression: os.path.dirname("bare.jsonl") == "" used to crash makedirs
+    monkeypatch.chdir(tmp_path)
+    append_json_log("bare.jsonl", {"n": 1}, stream=None)
+    append_json_log("bare.jsonl", {"n": 2}, stream=None)
+    recs = read_events(str(tmp_path / "bare.jsonl"))
+    assert [r["n"] for r in recs] == [1, 2]
+    assert all("ts" in r for r in recs)
+
+
+# --- watchdog ---------------------------------------------------------------
+
+def _stalls(run_dir):
+    return [e for e in read_events(str(run_dir / "events.jsonl"))
+            if e["event"] == "stall"]
+
+
+def test_watchdog_fires_on_frozen_step(tmp_path):
+    run = tmp_path / "frozen"
+    tel = Telemetry(str(run), stall_deadline_s=0.2, first_step_grace=1.0,
+                    watch_interval_s=0.05)
+    tel.step(1, data_wait_s=0.0, dispatch_s=0.0, fetch_s=0.0)
+    deadline = time.monotonic() + 10.0
+    while not _stalls(run) and time.monotonic() < deadline:
+        time.sleep(0.05)  # the "step" is frozen: no further heartbeats
+    tel.close()
+    stalls = _stalls(run)
+    assert stalls, "watchdog never fired on a frozen step"
+    assert stalls[0]["seconds_since_step"] >= 0.2
+    assert stalls[0]["deadline_s"] == 0.2
+    # one record per episode, not one per poll
+    assert len(stalls) == 1
+
+
+def test_watchdog_silent_on_healthy_run(tmp_path):
+    run = tmp_path / "healthy"
+    tel = Telemetry(str(run), stall_deadline_s=2.0, first_step_grace=1.0,
+                    watch_interval_s=0.05)
+    for i in range(12):
+        tel.step(i + 1, data_wait_s=0.0, dispatch_s=0.0, fetch_s=0.0)
+        time.sleep(0.05)
+    tel.close()
+    assert _stalls(run) == []
+
+
+def test_watchdog_rearms_after_recovery(tmp_path):
+    run = tmp_path / "recover"
+    tel = Telemetry(str(run), stall_deadline_s=0.15, first_step_grace=1.0,
+                    watch_interval_s=0.03)
+    tel.step(1, data_wait_s=0.0, dispatch_s=0.0, fetch_s=0.0)
+    deadline = time.monotonic() + 10.0
+    while len(_stalls(run)) < 1 and time.monotonic() < deadline:
+        time.sleep(0.03)
+    tel.step(2, data_wait_s=0.0, dispatch_s=0.0, fetch_s=0.0)  # recovery
+    while len(_stalls(run)) < 2 and time.monotonic() < deadline:
+        time.sleep(0.03)
+    tel.close()
+    assert len(_stalls(run)) == 2  # a second episode after re-arming
+
+
+# --- summarizer -------------------------------------------------------------
+
+def test_summarize_run_merges_events_and_trace(tmp_path):
+    run = tmp_path / "run"
+    _write_run(run)
+
+    # a real (CPU) profiler capture under the run dir — no TPU required
+    import jax
+    import jax.numpy as jnp
+    from raft_stereo_tpu.utils.profiling import trace
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(x @ x.T)
+
+    x = jnp.ones((128, 128))
+    float(f(x))
+    with trace(str(run / "trace")):
+        float(f(x))
+
+    report = summarize_run(str(run))
+    ev = report["events"]
+    assert ev["steps"] == 6
+    assert ev["phases"]["dispatch_s"]["total"] == pytest.approx(0.3, rel=0.05)
+    assert ev["phases"]["data_wait_s"]["p50"] > 0
+    assert ev["compiles"]["count"] >= 1
+    assert ev["validations"] == [{"things-epe": 1.5}]
+    assert ev["run_end"]["ok"] is True
+    assert report["trace"] is not None and "error" not in report["trace"]
+    assert report["schema_errors"] == []
+
+    text = format_summary(report)
+    assert "per-step phases" in text
+    assert "dispatch_s" in text
+    assert "throughput trend" in text
+    assert "total device-op time" in text  # the merged trace half
+
+
+def test_cli_telemetry_renders_synthetic_run(tmp_path, capsys):
+    _write_run(tmp_path / "run")
+    from raft_stereo_tpu.cli import main
+    assert main(["telemetry", str(tmp_path / "run")]) == 0
+    out = capsys.readouterr().out
+    assert "per-step phases" in out
+    assert "validation: {'things-epe': 1.5}" in out
+    assert "stalls: none" in out
+
+
+def test_summarize_run_without_artifacts(tmp_path):
+    report = summarize_run(str(tmp_path))
+    assert report["events"] is None and report["trace"] is None
+    text = format_summary(report)
+    assert "events: none" in text and "trace: none" in text
+
+
+# --- schema lint (scripts/check_events.py) ----------------------------------
+
+def _check_events():
+    sys.path.insert(0, str(REPO / "scripts"))
+    import check_events
+    return check_events
+
+
+def test_check_events_accepts_conforming_log(tmp_path):
+    _write_run(tmp_path / "run")
+    ce = _check_events()
+    assert ce.main([str(tmp_path / "run")]) == 0
+    assert ce.main([str(tmp_path / "run" / "events.jsonl")]) == 0
+
+
+def test_check_events_rejects_drift(tmp_path):
+    run = tmp_path / "run"
+    _write_run(run)
+    path = run / "events.jsonl"
+    ce = _check_events()
+    # a record from a future schema version must fail the lint
+    with open(path, "a") as f:
+        f.write(json.dumps({"schema": SCHEMA_VERSION + 1,
+                            "ts": "2026-01-01T00:00:00",
+                            "event": "step", "step": 1, "data_wait_s": 0,
+                            "dispatch_s": 0, "fetch_s": 0}) + "\n")
+    assert ce.main([str(run)]) == 1
+    assert ce.main([str(tmp_path / "missing")]) == 1
+
+
+# --- bench.py rides the same sink -------------------------------------------
+
+def test_bench_chain_logs_attempts_through_sink(tmp_path):
+    import bench
+    chain = [dict(kw={"tag": "a"}, when="always", note="primary"),
+             dict(kw={"tag": "b"}, when="unbanked", note="fallback")]
+
+    def runner(kw, timeout_s=None):
+        return ({"metric": "m", "value": 5.0} if kw["tag"] == "a" else None)
+
+    log = tmp_path / "bench" / "attempts.jsonl"
+    best = bench.run_chain(chain, runner, log_path=str(log))
+    assert best["value"] == 5.0
+    recs = read_events(str(log))
+    assert [r["status"] for r in recs] == ["ok", "skipped"]
+    assert recs[0]["result"]["value"] == 5.0
+    assert all("ts" in r for r in recs)

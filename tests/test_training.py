@@ -126,6 +126,7 @@ def test_train_step_updates_params_and_metrics(tiny_setup):
 
 # ------------------------------------------------------------------- parallel
 
+@pytest.mark.slow  # full-model 8-device XLA-CPU compile, minutes of wall clock
 def test_dryrun_multichip_8dev():
     """The driver's multi-chip validation path: dp x sp pjit step and
     explicit shard_map DP step, one step each on the virtual 8-CPU mesh."""
@@ -134,6 +135,7 @@ def test_dryrun_multichip_8dev():
     dryrun_train_step(8)
 
 
+@pytest.mark.slow  # full-model 8-device XLA-CPU compile, minutes of wall clock
 def test_shardmap_dp_matches_single_device():
     """psum-reduced DP gradients must equal the single-device gradients."""
     from raft_stereo_tpu.parallel.mesh import make_mesh, replicated
@@ -174,6 +176,7 @@ def test_shardmap_dp_matches_single_device():
                                    rtol=2e-3, atol=2e-5)
 
 
+@pytest.mark.slow  # full-model 8-device XLA-CPU compile, minutes of wall clock
 def test_pjit_stacked_step_runs():
     """trainer.py's multi-chip combination — make_pjit_train_step with the
     default stacked loss — must compile and execute on a dp x sp mesh (the
@@ -206,6 +209,7 @@ def test_pjit_stacked_step_runs():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # full-model 8-device XLA-CPU compile, minutes of wall clock
 def test_shardmap_fused_matches_single_device_fused():
     """The fused-loss shard_map DP step must equal the single-device
     fused-loss step (psum-global normalization of the in-scan error sums)."""
