@@ -55,7 +55,7 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               upsample_tile_budget=None, remat_loss_tail=True,
               fold_enc_saves=None, scan_unroll=1,
               refinement_save_policy=None, corr_implementation="reg",
-              compile_only=False):
+              corr_storage_dtype="bfloat16", compile_only=False):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
     # degraded windows (r3: every big graph rejected; r4: wedged for hours);
@@ -78,9 +78,12 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
     platform = jax.devices()[0].platform
     n_chips = jax.device_count()
 
+    # bf16 volume storage has been the bench default since r4 (0.001% EPE
+    # cost, PARITY.md r2; halves the B*H*W^2 residency); the explicit kwarg
+    # lets the frontier harness A/B it instead of baking it in silently.
     cfg = RAFTStereoConfig(mixed_precision=True,
                            corr_implementation=corr_implementation,
-                           corr_storage_dtype="bfloat16",
+                           corr_storage_dtype=corr_storage_dtype,
                            remat_encoders=remat_encoders,
                            fused_lookup=fused_lookup,
                            upsample_tile_budget=upsample_tile_budget,
@@ -198,6 +201,13 @@ def primary_attempt_kwargs():
 # reaches it, so regressions in newer paths can't silently cap the round.
 _PAR_PAIRS_PER_SEC = 9.5
 
+# Timed steps for the banker attempt (the recipe's 6 elsewhere): 12 halves
+# the sample noise of the banked number (VERDICT r5 #2 — the r5 artifact
+# wobbled 0.7% below README's in-round best on a 6-step sample). Only the
+# banker pays for it: fallbacks exist to land ANY number, the banker to
+# land a STABLE one.
+_BANKER_TIMED_STEPS = 12
+
 
 def _attempt_chain(on_tpu):
     """Ordered attempt list. ``when`` controls skipping:
@@ -239,9 +249,15 @@ def _attempt_chain(on_tpu):
         # rematting less (layer1_0 alone, in either scoping) is
         # helper-rejected, the measured frontier. below_par (not
         # unbanked): even if the primary lands, a below-par primary must
-        # not cap the round.
+        # not cap the round. Timed steps are doubled vs the recipe
+        # (VERDICT r5 #2): a single 6-step sample put the banked number
+        # anywhere in the 9.55-9.64 band, sometimes under already-published
+        # figures; with the executable .jax_cache-warm the compile is free,
+        # so the budget goes to measurement. `steps` is host-side loop
+        # count — the HLO and persistent-cache key are unchanged.
         dict(kw=dict(batch=8, fused_loss=True,
-                     remat_encoders="blocks_hires", **best_sched, **recipe),
+                     remat_encoders="blocks_hires", **best_sched,
+                     **{**recipe, "steps": _BANKER_TIMED_STEPS}),
              when="below_par", note="hires-blocks banker, r4 best schedule"),
         # The full blocks-remat config: ~1.7 GB less residency than the
         # banker and proven over three rounds of sessions — the next stop

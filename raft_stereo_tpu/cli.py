@@ -212,6 +212,21 @@ def build_eval_parser() -> argparse.ArgumentParser:
     parser.add_argument("--bucket", type=int, default=0,
                         help="pad eval images up to multiples of this size "
                              "to bound recompiles (0 = exact /32 padding)")
+    g = parser.add_argument_group(
+        "streaming", "pipelined evaluation (eval/stream.py): overlap frame "
+        "decode, device dispatch and result fetch instead of paying them "
+        "serially per frame")
+    g.add_argument("--stream", choices=["auto", "on", "off"], default="auto",
+                   help="auto streams whenever the predictor supports async "
+                        "dispatch; off reproduces the serial loop (and, on "
+                        "kitti, the device-only FPS measurement)")
+    g.add_argument("--stream_window", type=int, default=3,
+                   help="max in-flight device dispatches (1 = no overlap)")
+    g.add_argument("--stream_microbatch", type=int, default=1,
+                   help="stack up to this many consecutive same-shape "
+                        "frames through one dispatch")
+    g.add_argument("--decode_workers", type=int, default=2,
+                   help="background frame-decode threads")
     add_model_args(parser)
     return parser
 
@@ -270,22 +285,30 @@ def _eval_main():
     _, variables = load_variables(args.restore_ckpt, cfg)
     predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
                                 bucket=args.bucket)
+    from raft_stereo_tpu.eval.stream import StreamConfig
+    stream = StreamConfig(
+        enabled={"auto": None, "on": True, "off": False}[args.stream],
+        window=args.stream_window, microbatch=args.stream_microbatch,
+        decode_workers=args.decode_workers)
     tel = None
     if args.run_dir:
         from raft_stereo_tpu.obs import Telemetry
         tel = Telemetry(args.run_dir, stall_deadline_s=None)
         tel.run_start(config={"dataset": args.dataset,
-                              "valid_iters": args.valid_iters})
+                              "valid_iters": args.valid_iters,
+                              "stream": args.stream,
+                              "stream_window": args.stream_window,
+                              "stream_microbatch": args.stream_microbatch})
     try:
         if args.dataset.startswith("middlebury_"):
             results = validate_middlebury(predictor, args.data_root,
                                           args.valid_iters,
                                           split=args.dataset.split("_")[1],
-                                          telemetry=tel)
+                                          telemetry=tel, stream=stream)
         else:
             results = VALIDATORS[args.dataset](predictor, args.data_root,
                                                args.valid_iters,
-                                               telemetry=tel)
+                                               telemetry=tel, stream=stream)
     except BaseException as e:
         if tel is not None:
             tel.error(e)

@@ -1,3 +1,4 @@
+from raft_stereo_tpu.eval.stream import StreamConfig, run_frames
 from raft_stereo_tpu.eval.validate import (
     validate_eth3d,
     validate_kitti,
@@ -5,5 +6,5 @@ from raft_stereo_tpu.eval.validate import (
     validate_things,
 )
 
-__all__ = ["validate_eth3d", "validate_kitti", "validate_middlebury",
-           "validate_things"]
+__all__ = ["StreamConfig", "run_frames", "validate_eth3d", "validate_kitti",
+           "validate_middlebury", "validate_things"]

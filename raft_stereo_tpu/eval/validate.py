@@ -22,22 +22,33 @@ which runs the reference's own validate_* as the oracle).
 
 All metric arithmetic happens in numpy on the host — the device computes only
 the forward pass, via :class:`raft_stereo_tpu.inference.StereoPredictor`
-(which buckets shapes to bound recompiles).
+(which buckets shapes to bound recompiles). The frame loop itself lives in
+eval/stream.py: one driver feeds all four validators, either sequentially or
+as a decode/dispatch/fetch pipeline (``stream=``), with per-frame metric
+closures applied in index order as results retire — so streaming changes
+WHEN metrics are computed, never WHAT they aggregate to.
+
+Frames whose validity mask is empty are skipped with a warning instead of
+poisoning the aggregate: ``epe[valid].mean()`` over zero pixels is NaN (the
+reference would print NaN there too — on real dataset trees the case does
+not arise, so the skip never diverges from oracle numbers).
 """
 
 from __future__ import annotations
 
 import logging
 import os.path as osp
-import time
-from typing import Dict, Optional
+from typing import Dict, Union
 
 import numpy as np
 
 from raft_stereo_tpu.data import datasets
+from raft_stereo_tpu.eval.stream import StreamConfig, run_frames
 from raft_stereo_tpu.inference import StereoPredictor
 
 logger = logging.getLogger(__name__)
+
+StreamArg = Union[None, bool, StreamConfig]
 
 
 def _epe(flow_pred: np.ndarray, flow_gt: np.ndarray) -> np.ndarray:
@@ -45,11 +56,14 @@ def _epe(flow_pred: np.ndarray, flow_gt: np.ndarray) -> np.ndarray:
     return np.sqrt(np.sum((flow_pred - flow_gt) ** 2, axis=-1))
 
 
-def _predict(predictor: StereoPredictor, sample, iters: int):
-    img1 = sample["image1"][None]
-    img2 = sample["image2"][None]
-    flow_up = predictor(img1, img2, iters)  # (1, H, W, 1)
-    return flow_up[0]
+def _usable(valid: np.ndarray, dataset: str, index: int) -> bool:
+    """Guard the empty-valid-mask NaN: skip-and-warn instead of averaging
+    a NaN into the run (see module doc)."""
+    if valid.any():
+        return True
+    logger.warning("%s frame %d: validity mask is empty — frame skipped "
+                   "(its per-image mean would be NaN)", dataset, index)
+    return False
 
 
 def _emit(telemetry, dataset: str, results: Dict[str, float]) -> None:
@@ -60,22 +74,27 @@ def _emit(telemetry, dataset: str, results: Dict[str, float]) -> None:
 
 
 def validate_eth3d(predictor: StereoPredictor, root: str = "datasets",
-                   iters: int = 32, telemetry=None) -> Dict[str, float]:
+                   iters: int = 32, telemetry=None,
+                   stream: StreamArg = None) -> Dict[str, float]:
     """ETH3D two-view validation: EPE + bad-1px (evaluate_stereo.py:19-56)."""
     ds = datasets.ETH3D(root=osp.join(root, "ETH3D"))
     if len(ds) == 0:
         raise ValueError(f"no samples found under {root!r}")
     epe_list, out_list = [], []
-    for i in range(len(ds)):
-        sample = ds.sample(i)
-        flow_pr = _predict(predictor, sample, iters)
+
+    def consume(i, sample, flow_pr, timing):
         flow_gt = sample["flow"]
         valid = sample["valid"] >= 0.5
+        if not _usable(valid, "eth3d", i):
+            return
         epe = _epe(flow_pr, flow_gt)
         epe_list.append(epe[valid].mean().item())
         # image-weighted D1: the reference appends each image's scalar mean
         # (evaluate_stereo.py:43-47) and averages the scalars (:53)
         out_list.append((epe > 1.0)[valid].mean().item())
+
+    run_frames(predictor, ds, consume, iters=iters, stream=stream,
+               telemetry=telemetry)
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.mean(out_list))
     logger.info("Validation ETH3D: EPE %f, D1 %f", epe, d1)
@@ -86,53 +105,54 @@ def validate_eth3d(predictor: StereoPredictor, root: str = "datasets",
 
 def validate_kitti(predictor: StereoPredictor, root: str = "datasets",
                    iters: int = 32,
-                   warmup_frames: int = 50, telemetry=None
-                   ) -> Dict[str, float]:
+                   warmup_frames: int = 50, telemetry=None,
+                   stream: StreamArg = None) -> Dict[str, float]:
     """KITTI-15 training-split validation: EPE + bad-3px + FPS
     (evaluate_stereo.py:59-108).
 
-    Two FPS numbers are reported: ``kitti-fps`` times the DEVICE forward
-    only (``StereoPredictor.predict_timed``) — the number comparable to the
-    reference, which brackets only the ``model(...)`` call (:77-79) — and
-    ``kitti-fps-e2e`` additionally includes padding, H2D transfer and the
-    host fetch of the full disparity map. Frames ``0..warmup_frames`` are
-    excluded like the reference's ``val_id > 50`` cudnn-autotune warmup
+    Sequentially, two FPS numbers are reported: ``kitti-fps`` times the
+    DEVICE forward only (``StereoPredictor.predict_timed``) — the number
+    comparable to the reference, which brackets only the ``model(...)`` call
+    (:77-79) — and ``kitti-fps-e2e`` additionally includes padding, H2D
+    transfer and the host fetch of the full disparity map. In streaming mode
+    the per-frame device sync that ``kitti-fps`` needs would re-serialize
+    the pipeline, so only ``kitti-fps-e2e`` is reported — computed from
+    retire intervals, the pipelined throughput that converges toward the
+    device-side FPS as overlap wins (PERF.md). Frames ``0..warmup_frames``
+    are excluded like the reference's ``val_id > 50`` cudnn-autotune warmup
     (:81)."""
     ds = datasets.KITTI(root=osp.join(root, "KITTI"), image_set="training")
     if len(ds) == 0:
         raise ValueError(f"no samples found under {root!r}")
     epe_list, out_list, elapsed_dev, elapsed_e2e = [], [], [], []
-    for i in range(len(ds)):
-        t_load = time.perf_counter()
-        sample = ds.sample(i)
-        t0 = time.perf_counter()
-        flow_pr, dt_dev = predictor.predict_timed(
-            sample["image1"][None], sample["image2"][None], iters)
-        flow_pr = flow_pr[0]
-        dt_e2e = time.perf_counter() - t0
-        if telemetry is not None:
-            # per-frame phase split: decode wait / device forward / the
-            # pad+transfer+fetch overhead around it
-            telemetry.step(i + 1, data_wait_s=t0 - t_load, dispatch_s=dt_dev,
-                           fetch_s=max(dt_e2e - dt_dev, 0.0), batch_size=1)
+
+    def consume(i, sample, flow_pr, timing):
         if i > warmup_frames:
-            elapsed_dev.append(dt_dev)
-            elapsed_e2e.append(dt_e2e)
+            if timing.device_s is not None:
+                elapsed_dev.append(timing.device_s)
+            elapsed_e2e.append(timing.e2e_s)
         flow_gt = sample["flow"]
         valid = sample["valid"] >= 0.5
+        if not _usable(valid, "kitti", i):
+            return
         epe = _epe(flow_pr, flow_gt)
         epe_list.append(epe[valid].mean().item())
         # pixel-weighted D1: the reference concatenates per-pixel outlier
         # masks here (evaluate_stereo.py:97-103), unlike ETH3D/Middlebury
         out_list.append((epe > 3.0)[valid])
+
+    run_frames(predictor, ds, consume, iters=iters, stream=stream,
+               telemetry=telemetry, timed=True)
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.concatenate(out_list).mean())
     result = {"kitti-epe": epe, "kitti-d1": d1}
     if elapsed_dev:
         result["kitti-fps"] = 1.0 / float(np.mean(elapsed_dev))
+    if elapsed_e2e:
         result["kitti-fps-e2e"] = 1.0 / float(np.mean(elapsed_e2e))
-        logger.info("Validation KITTI: EPE %f, D1 %f, %f FPS (%f e2e)",
-                    epe, d1, result["kitti-fps"], result["kitti-fps-e2e"])
+        logger.info("Validation KITTI: EPE %f, D1 %f, %s FPS (%f e2e)",
+                    epe, d1, result.get("kitti-fps", "n/a (streamed)"),
+                    result["kitti-fps-e2e"])
     else:
         logger.info("Validation KITTI: EPE %f, D1 %f", epe, d1)
     _emit(telemetry, "kitti", result)
@@ -141,25 +161,30 @@ def validate_kitti(predictor: StereoPredictor, root: str = "datasets",
 
 def validate_things(predictor: StereoPredictor, root: str = "datasets",
                     iters: int = 32,
-                    max_disp: float = 192.0, telemetry=None
-                    ) -> Dict[str, float]:
+                    max_disp: float = 192.0, telemetry=None,
+                    stream: StreamArg = None) -> Dict[str, float]:
     """FlyingThings3D TEST split: EPE + bad-1px over ``|disp| < max_disp``
     (evaluate_stereo.py:111-146). Doubles as the in-training validation hook
-    (train_stereo.py:188)."""
+    (train_stereo.py:188). The test split is a single image shape, so the
+    streaming path's micro-batching applies to every frame."""
     ds = datasets.SceneFlow(root=root, dstype="frames_finalpass",
                             things_test=True)
     if len(ds) == 0:
         raise ValueError(f"no samples found under {root!r}")
     epe_list, out_list = [], []
-    for i in range(len(ds)):
-        sample = ds.sample(i)
-        flow_pr = _predict(predictor, sample, iters)
+
+    def consume(i, sample, flow_pr, timing):
         flow_gt = sample["flow"]
         epe = _epe(flow_pr, flow_gt)
         valid = (sample["valid"] >= 0.5) & \
                 (np.abs(flow_gt[..., 0]) < max_disp)
+        if not _usable(valid, "things", i):
+            return
         epe_list.append(epe[valid].mean().item())
         out_list.append((epe > 1.0)[valid])
+
+    run_frames(predictor, ds, consume, iters=iters, stream=stream,
+               telemetry=telemetry)
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.concatenate(out_list).mean())
     logger.info("Validation FlyingThings: EPE %f, D1 %f", epe, d1)
@@ -170,7 +195,8 @@ def validate_things(predictor: StereoPredictor, root: str = "datasets",
 
 def validate_middlebury(predictor: StereoPredictor, root: str = "datasets",
                         iters: int = 32,
-                        split: str = "F", telemetry=None) -> Dict[str, float]:
+                        split: str = "F", telemetry=None,
+                        stream: StreamArg = None) -> Dict[str, float]:
     """Middlebury MiddEval3 validation: EPE + bad-2px (evaluate_stereo.py:149-189).
 
     ``split`` in {'F','H','Q'}. Mask semantics replicate the reference
@@ -184,14 +210,18 @@ def validate_middlebury(predictor: StereoPredictor, root: str = "datasets",
     if len(ds) == 0:
         raise ValueError(f"no samples found under {root!r}")
     epe_list, out_list = [], []
-    for i in range(len(ds)):
-        sample = ds.sample(i)
-        flow_pr = _predict(predictor, sample, iters)
+
+    def consume(i, sample, flow_pr, timing):
         flow_gt = sample["flow"]
-        epe = _epe(flow_pr, flow_gt)
         valid = (sample["valid"] >= -0.5) & (flow_gt[..., 0] > -1000)
+        if not _usable(valid, f"middlebury{split}", i):
+            return
+        epe = _epe(flow_pr, flow_gt)
         epe_list.append(epe[valid].mean().item())
         out_list.append((epe > 2.0)[valid].mean().item())
+
+    run_frames(predictor, ds, consume, iters=iters, stream=stream,
+               telemetry=telemetry)
     epe = float(np.mean(epe_list))
     d1 = 100 * float(np.mean(out_list))
     logger.info("Validation Middlebury%s: EPE %f, D1 %f", split, epe, d1)

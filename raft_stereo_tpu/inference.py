@@ -11,7 +11,8 @@ bucket instead of once per image.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,47 @@ def bucket_size(n: int, divis: int, bucket: int = 0) -> int:
     return -(-n // divis) * divis
 
 
+class PendingPrediction:
+    """Handle for an in-flight :meth:`StereoPredictor.predict_async` call.
+
+    The device array stays on device until :meth:`result` is called — the
+    D2H fetch (and, on tunneled devices, the tunnel round-trip it pays) is
+    deferred so callers can keep dispatching while earlier frames compute.
+    """
+
+    def __init__(self, flow_dev, unpad: Callable, dispatch_s: float):
+        self._flow = flow_dev
+        self._unpad = unpad
+        self._result: Optional[np.ndarray] = None
+        #: host seconds spent inside the dispatching call (async enqueue,
+        #: not device time)
+        self.dispatch_s = dispatch_s
+        #: host seconds :meth:`result` spent blocked on the fetch
+        self.fetch_s: Optional[float] = None
+
+    def ready(self) -> bool:
+        """Best-effort non-blocking completion probe (True when a fetch
+        would not block; conservatively False where the backend cannot
+        tell)."""
+        if self._result is not None:
+            return True
+        is_ready = getattr(self._flow, "is_ready", None)
+        try:
+            return bool(is_ready()) if is_ready is not None else False
+        except Exception:
+            return False
+
+    def result(self) -> np.ndarray:
+        """Block until the dispatch completes; unpadded ``(B, H, W, 1)``
+        flow-x as numpy. Idempotent — later calls return the cached fetch."""
+        if self._result is None:
+            t0 = time.perf_counter()
+            self._result = np.asarray(self._unpad(self._flow))
+            self.fetch_s = time.perf_counter() - t0
+            self._flow = None  # release the device buffer reference
+        return self._result
+
+
 class StereoPredictor:
     """Jitted stereo inference with per-shape compile caching.
 
@@ -50,7 +92,7 @@ class StereoPredictor:
         self.variables = variables
         self.valid_iters = valid_iters
         self.bucket = bucket
-        self._compiled: Dict[Tuple[int, int, int, int], any] = {}
+        self._compiled: Dict[Tuple[int, int, int, int], Any] = {}
         # "ring" shards the width axis over every available device (sequence
         # parallelism for very wide pairs). Pad W so each device's 1/factor-
         # resolution shard still pools 2^(levels-1)-fold locally.
@@ -137,6 +179,25 @@ class StereoPredictor:
             float(flow_up[0, 0, 0, 0])  # host fetch of one element = sync
             dt = _time.perf_counter() - t0
         return np.asarray(padder.unpad(flow_up)), dt
+
+    def predict_async(self, image1: np.ndarray, image2: np.ndarray,
+                      iters: Optional[int] = None) -> PendingPrediction:
+        """Dispatch one batched forward and return immediately.
+
+        Inputs are staged onto the device and the jitted call is enqueued
+        (JAX dispatch is asynchronous); nothing blocks on device completion.
+        The returned :class:`PendingPrediction` fetches the unpadded flow on
+        ``result()``. With a bounded window of outstanding handles, frame
+        *i*'s fetch and host post-processing overlap frames *i+1…i+K*'s
+        device compute — the per-call tunnel RTT and host time amortize away
+        exactly like the training loop's chained dispatch (see
+        eval/stream.py, which drives this)."""
+        t0 = time.perf_counter()
+        padder, fn, im1, im2, ctx = self._prepared(image1, image2, iters)
+        with ctx:
+            _, flow_up = fn(self.variables, im1, im2)
+        return PendingPrediction(flow_up, padder.unpad,
+                                 time.perf_counter() - t0)
 
     def compute_disparity(self, left: np.ndarray, right: np.ndarray,
                           iters: Optional[int] = None) -> np.ndarray:

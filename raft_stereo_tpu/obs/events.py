@@ -45,6 +45,9 @@ EVENT_TYPES: Dict[str, tuple] = {
     "throughput": ("pairs_per_sec", "steps"),
     "memory": ("stats",),
     "loader": ("queue_depth",),
+    # Streaming-eval pipeline gauge (eval/stream.py): device dispatches
+    # currently in flight; `window`/`microbatch` ride along as extras.
+    "pipeline": ("in_flight",),
     "stall": ("seconds_since_step", "deadline_s"),
     "error": ("error",),
     "run_end": ("steps",),

@@ -60,6 +60,46 @@ def _throughput_trend(steps: List[Dict[str, Any]],
     return trend
 
 
+def _pipeline_overlap(steps: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Overlap efficiency of a pipelined run: serial phase work / wall time.
+
+    ``serial_s`` sums every step's data-wait + dispatch + fetch; ``wall_s``
+    spans the monotonic ``t`` axis from the first to the last step record.
+    Sequential loops land at ~1.0 (all phase work on the critical path);
+    values above 1.0 mean the pipeline overlapped that much host/device
+    work per unit of wall clock (eval/stream.py's whole purpose); well
+    below 1.0 means time went somewhere the phase split doesn't see.
+    """
+    timed = [s for s in steps if "t" in s]
+    if len(timed) < 2:
+        return None
+    wall = timed[-1]["t"] - timed[0]["t"]
+    if wall <= 0:
+        return None
+    # the first record's phases happened before its own `t` stamp, i.e.
+    # outside the [t_first, t_last] window — sum the in-window steps only
+    serial = sum(sum(s.get(p, 0.0) for p in _PHASES) for s in timed[1:])
+    return {"serial_s": round(serial, 4), "wall_s": round(wall, 4),
+            "efficiency": round(serial / wall, 3)}
+
+
+def _pipeline_gauges(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    import numpy as np
+    gauges = [e for e in events if e.get("event") == "pipeline"]
+    if not gauges:
+        return None
+    depths = [g["in_flight"] for g in gauges if "in_flight" in g]
+    out: Dict[str, Any] = {"gauges": len(gauges)}
+    if depths:
+        out["in_flight_p50"] = float(np.median(depths))
+        out["in_flight_max"] = int(max(depths))
+    last = gauges[-1]
+    for k in ("window", "microbatch"):
+        if k in last:
+            out[k] = last[k]
+    return out
+
+
 def _find_trace_dir(run_dir: str) -> Optional[str]:
     hits = glob.glob(os.path.join(run_dir, "**", "plugins", "profile"),
                      recursive=True)
@@ -103,6 +143,8 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "phases": {p: _percentiles([s[p] for s in steps if p in s])
                    for p in _PHASES if any(p in s for s in steps)},
         "throughput_trend": _throughput_trend(steps),
+        "pipeline_overlap": _pipeline_overlap(steps),
+        "pipeline": _pipeline_gauges(events),
         "compiles": {
             "count": len(by("compile")),
             "total_s": round(sum(e.get("duration_s", 0.0)
@@ -155,6 +197,21 @@ def format_summary(report: Dict[str, Any]) -> str:
             for w in ev["throughput_trend"]:
                 lines.append(f"  steps {w['steps'][0]}-{w['steps'][1]}: "
                              f"{w['pairs_per_sec']}")
+        ov = ev.get("pipeline_overlap")
+        if ov:
+            lines.append("")
+            lines.append(f"pipeline overlap: {ov['efficiency']}x "
+                         f"({ov['serial_s']}s of phase work in "
+                         f"{ov['wall_s']}s wall)")
+        pg = ev.get("pipeline")
+        if pg:
+            depth = (f"in-flight p50 {pg['in_flight_p50']} "
+                     f"max {pg['in_flight_max']}"
+                     if "in_flight_p50" in pg else "no depth samples")
+            extras = ", ".join(f"{k}={pg[k]}" for k in ("window", "microbatch")
+                               if k in pg)
+            lines.append(f"pipeline gauges: {pg['gauges']} ({depth}"
+                         + (f", {extras}" if extras else "") + ")")
         c = ev["compiles"]
         lines.append("")
         lines.append(f"compiles: {c['count']} ({c['total_s']} s)")

@@ -215,6 +215,11 @@ class Telemetry:
         """Queue-depth/wait gauges from the data pipeline's producer thread."""
         self.emit("loader", **gauges)
 
+    def pipeline(self, in_flight: int, **payload: Any) -> None:
+        """In-flight-depth gauge from the streaming eval pipeline
+        (eval/stream.py); 0 means the device queue drained."""
+        self.emit("pipeline", in_flight=int(in_flight), **payload)
+
     def error(self, exc: BaseException) -> None:
         self.emit("error", error=f"{type(exc).__name__}: {exc}",
                   traceback="".join(traceback.format_exception(

@@ -1,4 +1,4 @@
-"""Measure the batch-scaling frontier past b8 (VERDICT r4 item 2).
+"""Measure the batch-scaling frontier past b8 (VERDICT r4 item 2, r5 #3).
 
 PERF.md's ceiling argument rests on a ~450 ms batch-independent serial floor
 fitted from b2/b4/b8 (r1); the floor amortizes with per-chip batch, and the
@@ -9,11 +9,21 @@ best schedule) and falling back to the memory-frugal schedule
 (remat_encoders=True + rematerialized loss tail + default chunk-on-pressure
 upsample budget) when the banker's residency no longer fits.
 
+Correlation-volume storage dtype (VERDICT r5 #3): ``run_bench`` has pinned
+``corr_storage_dtype="bfloat16"`` since r4 (commit 8aa95de), so every ladder
+row — including the r5 b9-b16 ladder — already ran with the halved-residency
+bf16 volume; the hypothesis that bf16 might reopen the >b8 lane was tested
+the day the ladder ran, just not visibly. The dtype is now an explicit,
+LOGGED kwarg on every row (``--dtypes``, default bfloat16), and passing
+``--dtypes bfloat16 float32`` adds the fp32 contrast rows that bound what
+the bf16 volume is actually buying at each batch.
+
 Results append to runs/batch_frontier.log as dated JSON lines; attempts run
 through bench.py's locked subprocess runner so they serialize with the
 monolith prober and any driver bench run.
 
 Run: python scripts/batch_frontier.py [--batches 10 12 16]
+     [--dtypes bfloat16 float32]
 """
 
 import argparse
@@ -38,6 +48,10 @@ def _log(entry):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batches", type=int, nargs="+", default=[10, 12, 16])
+    p.add_argument("--dtypes", nargs="+", default=["bfloat16"],
+                   choices=["bfloat16", "float32"],
+                   help="corr-volume storage dtypes to ladder (bf16 is the "
+                        "bench default; float32 adds the contrast row)")
     p.add_argument("--timeout", type=float, default=1500.0)
     args = p.parse_args()
 
@@ -46,22 +60,27 @@ def main():
     # upsample_tile_budget defaults to chunk-on-pressure
     best = None
     for b in args.batches:
-        for name, sched in (("banker", banker), ("frugal", frugal)):
-            kw = dict(batch=b, **sched, **RECIPE)
-            result, err, wall = run_attempt_subprocess_detailed(
-                kw, args.timeout)
-            _log({"batch": b, "schedule": name,
-                  "ok": result is not None,
-                  "pairs_per_sec": None if result is None else result["value"],
-                  "error": None if err is None else err[:300],
-                  "wall_s": round(wall, 1)})
-            if result is not None:
-                if best is None or result["value"] > best[2]:
-                    best = (b, name, result["value"])
-                break  # banker fits at this batch; frugal not needed
+        for dtype in args.dtypes:
+            for name, sched in (("banker", banker), ("frugal", frugal)):
+                kw = dict(batch=b, corr_storage_dtype=dtype, **sched,
+                          **RECIPE)
+                result, err, wall = run_attempt_subprocess_detailed(
+                    kw, args.timeout)
+                _log({"batch": b, "schedule": name,
+                      "corr_storage_dtype": dtype,
+                      "ok": result is not None,
+                      "pairs_per_sec":
+                          None if result is None else result["value"],
+                      "error": None if err is None else err[:300],
+                      "wall_s": round(wall, 1)})
+                if result is not None:
+                    if best is None or result["value"] > best[3]:
+                        best = (b, name, dtype, result["value"])
+                    break  # banker fits at this batch; frugal not needed
     _log({"done": True,
           "best": None if best is None else
-          {"batch": best[0], "schedule": best[1], "pairs_per_sec": best[2]}})
+          {"batch": best[0], "schedule": best[1],
+           "corr_storage_dtype": best[2], "pairs_per_sec": best[3]}})
     return 0
 
 

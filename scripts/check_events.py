@@ -3,9 +3,11 @@
 
 Validates every record of one or more ``events.jsonl`` files (or run
 directories containing one) against the current ``SCHEMA_VERSION`` and each
-event type's required fields, and exits non-zero on any violation — wired
-into the tier-1 run via tests/test_telemetry.py so schema drift fails tests
-instead of silently corrupting downstream summarizers.
+event type's required fields — including the streaming-eval ``pipeline``
+gauge (``in_flight`` required, obs/events.py) — and exits non-zero on any
+violation; wired into the tier-1 run via tests/test_telemetry.py and
+tests/test_eval_stream.py so schema drift fails tests instead of silently
+corrupting downstream summarizers.
 
 Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
 """

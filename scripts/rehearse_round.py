@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Round rehearsal: run the EXACT driver commands under the driver's budgets
+(VERDICT r5 #8).
+
+Both r5 artifact regressions — the bench number wobbling below published
+figures and the multichip dryrun timing out into an ``ok:false`` record —
+would have been caught by running the driver's own command lines, under the
+driver's own ``timeout`` budgets, once before round end. This script is that
+rehearsal:
+
+* **bench** — ``python bench.py`` (the full attempt chain, parent-mode),
+  bounded by ``BENCH_DEADLINE_S`` plus probe/teardown margin; the leg fails
+  unless stdout's last line parses as the result JSON with a numeric
+  ``value``.
+* **multichip** — ``python __graft_entry__.py`` (entry + dryrun_multichip),
+  bounded by ``GRAFT_DRYRUN_DEADLINE_S`` plus margin; the leg fails on
+  non-zero rc — the budget-aware stage skipping inside the entry point is
+  exactly what this rehearses.
+* **events** — schema lint (scripts/check_events.py semantics) over the
+  artifact logs a round leaves behind, so a drifted record fails here, not
+  in the next round's summarizer.
+
+Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
+shared obs/ sink; exit status is non-zero if any attempted leg failed, so
+the rehearsal can gate a round's end ritual.
+
+Run: python scripts/rehearse_round.py [--legs bench multichip events]
+     [--bench-budget S] [--multichip-budget S]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from raft_stereo_tpu.obs.events import append_json_log  # noqa: E402
+
+LOG = os.path.join(REPO, "runs", "rehearsal.log")
+
+# The driver's own budgets (bench.py _DEADLINE_S; __graft_entry__
+# _DRYRUN_DEADLINE_S), plus margin for the platform probe / interpreter
+# startup / teardown that runs outside the inner deadline's clock.
+BENCH_BUDGET_S = float(os.environ.get("BENCH_DEADLINE_S", "4800")) + 600
+MULTICHIP_BUDGET_S = float(
+    os.environ.get("GRAFT_DRYRUN_DEADLINE_S", "3600")) + 600
+
+
+def run_leg(name, cmd, timeout_s, cwd=REPO, check_stdout=None):
+    """Run one driver command under its budget; return the log record.
+
+    ``check_stdout(stdout) -> error_or_None`` validates the artifact the
+    driver would capture (e.g. the bench result JSON), because a command
+    that exits 0 with an unparseable artifact is still a failed round.
+    """
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, cwd=cwd, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout_s)
+        rc, out = proc.returncode, proc.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        out = out.decode(errors="replace") if isinstance(out, bytes) else out
+        rc = f"timeout>{timeout_s:.0f}s"
+    wall = time.monotonic() - t0
+    error = None
+    if rc != 0:
+        error = f"rc={rc}"
+    elif check_stdout is not None:
+        error = check_stdout(out)
+    return {
+        "leg": name,
+        "cmd": cmd if isinstance(cmd, str) else " ".join(cmd),
+        "ok": error is None,
+        "rc": rc,
+        "wall_s": round(wall, 1),
+        "budget_s": timeout_s,
+        "error": error,
+        "tail": "\n".join(out.splitlines()[-6:]),
+    }
+
+
+def check_bench_stdout(out):
+    """The driver parses bench.py's LAST stdout line as the result JSON."""
+    lines = [l for l in out.splitlines() if l.strip()]
+    if not lines:
+        return "empty stdout (no result JSON)"
+    try:
+        result = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return f"last line is not JSON: {lines[-1][:120]!r}"
+    if not isinstance(result.get("value"), (int, float)):
+        return f"result JSON has no numeric 'value': {result}"
+    return None
+
+
+def check_event_artifacts(paths):
+    """Schema-lint the round's JSONL artifacts that exist (missing is fine —
+    a round need not have produced every log)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import check_events
+    existing = [p for p in paths
+                if os.path.exists(p if not os.path.isdir(p)
+                                  else os.path.join(p, "events.jsonl"))]
+    errors = []
+    for p in existing:
+        # attempt/frontier logs are dated-JSON but not schema-stamped event
+        # records; only events.jsonl files go through the full lint
+        if os.path.basename(p) == "events.jsonl" or os.path.isdir(p):
+            errors.extend(check_events.check(p))
+    return existing, errors
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Rehearse the driver's end-of-round commands under the "
+                    "driver's budgets (see module doc)")
+    p.add_argument("--legs", nargs="+", default=["bench", "multichip",
+                                                 "events"],
+                   choices=["bench", "multichip", "events"])
+    p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
+    p.add_argument("--multichip-budget", type=float,
+                   default=MULTICHIP_BUDGET_S)
+    args = p.parse_args(argv)
+
+    records = []
+    if "bench" in args.legs:
+        records.append(run_leg(
+            "bench", [sys.executable, os.path.join(REPO, "bench.py")],
+            args.bench_budget, check_stdout=check_bench_stdout))
+    if "multichip" in args.legs:
+        records.append(run_leg(
+            "multichip",
+            [sys.executable, os.path.join(REPO, "__graft_entry__.py")],
+            args.multichip_budget))
+    if "events" in args.legs:
+        import glob
+        candidates = ([os.path.join(REPO, "runs", "bench", "attempts.jsonl")]
+                      + glob.glob(os.path.join(REPO, "runs", "*",
+                                               "events.jsonl")))
+        checked, errors = check_event_artifacts(candidates)
+        records.append({"leg": "events", "ok": not errors,
+                        "checked": checked, "error": "; ".join(errors[:5])
+                        or None})
+
+    ok = True
+    for rec in records:
+        append_json_log(LOG, rec, stream=sys.stderr)
+        ok = ok and rec["ok"]
+    print(("rehearsal ok: " if ok else "REHEARSAL FAILED: ")
+          + ", ".join(f"{r['leg']}={'ok' if r['ok'] else 'FAIL'}"
+                      for r in records))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""scripts/rehearse_round.py: the driver-shaped rehearsal harness
+(VERDICT r5 #8). The legs themselves shell out to bench.py /
+__graft_entry__.py and are exercised on the TPU host; here the leg runner,
+budget enforcement and artifact checks are pinned with stub commands."""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "scripts"))
+
+import rehearse_round  # noqa: E402
+
+
+def test_run_leg_success_with_artifact_check():
+    rec = rehearse_round.run_leg(
+        "bench", [sys.executable, "-c",
+                  "print('noise'); print('{\"value\": 9.5}')"],
+        timeout_s=60, check_stdout=rehearse_round.check_bench_stdout)
+    assert rec["ok"] and rec["rc"] == 0 and rec["error"] is None
+    assert rec["wall_s"] < 60
+
+
+def test_run_leg_rc_failure():
+    rec = rehearse_round.run_leg(
+        "bench", [sys.executable, "-c", "raise SystemExit(3)"], timeout_s=60)
+    assert not rec["ok"] and rec["error"] == "rc=3"
+
+
+def test_run_leg_budget_timeout():
+    rec = rehearse_round.run_leg(
+        "slow", [sys.executable, "-c", "import time; time.sleep(30)"],
+        timeout_s=1)
+    assert not rec["ok"]
+    assert "timeout" in str(rec["rc"])
+    assert rec["wall_s"] < 10
+
+
+def test_check_bench_stdout_rejects_bad_artifacts():
+    check = rehearse_round.check_bench_stdout
+    assert check('{"value": 9.58}\n') is None
+    assert check("") is not None                       # no output at all
+    assert check("all bench attempts failed\n")        # not JSON
+    assert check(json.dumps({"metric": "x"}) + "\n")   # no numeric value
+
+
+def test_check_event_artifacts_lints_event_logs_only(tmp_path):
+    good = tmp_path / "run" / "events.jsonl"
+    good.parent.mkdir()
+    from raft_stereo_tpu.obs import Telemetry
+    tel = Telemetry(str(good.parent))
+    tel.run_start()
+    tel.emit("run_end", steps=0, ok=True)
+    tel.close()
+    # a dated-JSON attempt log (no schema stamp) must be skipped, not flagged
+    attempts = tmp_path / "attempts.jsonl"
+    attempts.write_text('{"attempt": 0, "status": "ok"}\n')
+    checked, errors = rehearse_round.check_event_artifacts(
+        [str(good), str(attempts), str(tmp_path / "missing.jsonl")])
+    assert str(good) in checked and str(attempts) in checked
+    assert errors == []
+
+    bad = tmp_path / "bad" / "events.jsonl"
+    bad.parent.mkdir()
+    bad.write_text('{"schema": 999, "ts": "t", "event": "step"}\n')
+    _, errors = rehearse_round.check_event_artifacts([str(bad)])
+    assert errors

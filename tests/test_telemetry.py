@@ -28,6 +28,7 @@ def _write_run(run_dir, steps=6, stall_deadline_s=None, **tel_kw):
                  fetch_s=0.002, batch_size=2, loss=3.0 - 0.1 * i)
     tel.loader_gauge({"queue_depth": 3, "put_wait_s": 0.1,
                       "batches_produced": steps, "epoch": 0})
+    tel.pipeline(in_flight=2, window=3, microbatch=1)
     tel.checkpoint(steps, str(run_dir / "ckpt"))
     tel.validation({"things-epe": 1.5}, dataset="things")
     tel.window_throughput()
@@ -44,7 +45,7 @@ def test_events_schema_roundtrip(tmp_path):
     assert validate_events(events) == []
     kinds = {e["event"] for e in events}
     assert {"run_start", "step", "compile", "checkpoint", "validation",
-            "loader", "throughput", "memory", "run_end"} <= kinds
+            "loader", "pipeline", "throughput", "memory", "run_end"} <= kinds
     assert all(e["schema"] == SCHEMA_VERSION for e in events)
     # the monotonic axis is present and non-decreasing
     ts = [e["t"] for e in events]
@@ -60,6 +61,9 @@ def test_validate_record_catches_drift():
                             if k != "dispatch_s"})
     assert validate_record({**good, "event": "not-an-event"})
     assert validate_record("not a dict")
+    # the streaming-eval gauge: in_flight is required at this schema version
+    assert validate_record(make_record("pipeline", in_flight=2)) == []
+    assert validate_record(make_record("pipeline", window=3))
 
 
 def test_append_json_log_bare_filename(tmp_path, monkeypatch):
@@ -158,6 +162,32 @@ def test_summarize_run_merges_events_and_trace(tmp_path):
     assert "dispatch_s" in text
     assert "throughput trend" in text
     assert "total device-op time" in text  # the merged trace half
+
+
+def test_summarize_reports_pipeline_overlap(tmp_path):
+    """Synthetic pipelined run: 0.03 s of phase work per step landing every
+    0.01 s of wall clock -> overlap efficiency 3.0x, plus the in-flight
+    gauge section."""
+    run = tmp_path / "run"
+    path = str(run / "events.jsonl")
+    append_json_log(path, make_record("run_start", t=0.0, run="pipe"),
+                    stream=None)
+    for i in range(5):
+        append_json_log(path, make_record(
+            "step", t=0.01 * (i + 1), step=i + 1, data_wait_s=0.005,
+            dispatch_s=0.02, fetch_s=0.005, batch_size=1, in_flight=2),
+            stream=None)
+    append_json_log(path, make_record("pipeline", t=0.06, in_flight=2,
+                                      window=3, microbatch=2), stream=None)
+    report = summarize_run(str(run))
+    ov = report["events"]["pipeline_overlap"]
+    assert ov["efficiency"] == pytest.approx(3.0, rel=0.01)
+    assert ov["wall_s"] == pytest.approx(0.04)
+    pg = report["events"]["pipeline"]
+    assert pg["in_flight_max"] == 2 and pg["window"] == 3
+    text = format_summary(report)
+    assert "pipeline overlap: 3.0x" in text
+    assert "pipeline gauges: 1" in text
 
 
 def test_cli_telemetry_renders_synthetic_run(tmp_path, capsys):
