@@ -123,33 +123,70 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
                                        fused_loss=fused_loss),
                        donate_argnums=(0,))
 
-    if compile_only:
-        # Compile-retry harness mode (scripts/bank_monolith.py): build the
-        # SAME graph the timed attempt would run and compile it into the
-        # persistent cache — no timed steps. Once a degraded-helper recipe
-        # compiles in one healthy window, every later timed attempt hits the
-        # cache. ``lower().compile()`` produces the identical cache key to
-        # calling the jitted step (same HLO, same compile options).
-        t0 = time.perf_counter()
-        step.lower(state, batch_data).compile()
-        dt = time.perf_counter() - t0
-        return {
-            "metric": "compile_only",
-            "value": round(dt, 1),
-            "unit": "s_compile",
-            "platform": platform,
-            "batch": batch,
-            "train_iters": train_iters,
-            "image_size": [h, w],
-        }
+    # Run telemetry (optional): the parent chain points BENCH_RUN_DIR at the
+    # rotated runs/bench/current so every attempt leaves schema events —
+    # compile time, per-step phase split, throughput, and the xla_memory/
+    # xla_cost introspection records the compare gate (obs/compare.py) and
+    # `cli.py telemetry` read. Fail-open: a telemetry bug must not cost the
+    # round its number.
+    tel = None
+    run_dir = os.environ.get("BENCH_RUN_DIR")
+    if run_dir:
+        try:
+            from raft_stereo_tpu.obs import Telemetry
+            tel = Telemetry(run_dir, stall_deadline_s=None)
+            tel.run_start(config=dict(
+                batch=batch, h=h, w=w, train_iters=train_iters, steps=steps,
+                compile_only=bool(compile_only),
+                corr_storage_dtype=corr_storage_dtype,
+                remat_encoders=str(remat_encoders)))
+        except Exception as e:
+            print(f"bench telemetry disabled: {e!r}", file=sys.stderr)
+            tel = None
 
-    # Warmup: compile + one steady-state step. The loss fetch (device->host
-    # transfer of an executable output) is the synchronization point: on
-    # tunneled TPU devices (axon), block_until_ready has been observed to
-    # return before queued executions finish, but a host transfer of an output
-    # scalar cannot complete until its executable does.
-    state, _ = step(state, batch_data)
-    state, metrics = step(state, batch_data)
+    # AOT compile + introspection, both modes: ``lower().compile()`` builds
+    # the identical executable and persistent-cache key the first jitted
+    # dispatch would (same HLO, same compile options — the compile-retry
+    # harness's premise), and the compiled object's memory_analysis()/
+    # cost_analysis() say what the recipe NEEDS before it runs: peak bytes
+    # vs chip capacity, temp residency, flops/byte (obs/xla.py).
+    from raft_stereo_tpu.obs.xla import compact_xla_summary, introspect_compiled
+    t0 = time.perf_counter()
+    compiled = step.lower(state, batch_data).compile()
+    compile_s = time.perf_counter() - t0
+    if tel is not None:
+        tel.emit("compile", duration_s=round(compile_s, 3),
+                 source="bench_aot")
+    xla = compact_xla_summary(introspect_compiled(
+        compiled, tel, source=f"bench_b{batch}", extra={"batch": batch}))
+
+    def _result(metric, value, unit, **extra):
+        out = {
+            "metric": metric, "value": value, "unit": unit,
+            "platform": platform, "batch": batch,
+            "train_iters": train_iters, "image_size": [h, w],
+        }
+        if xla is not None:
+            out["xla"] = xla
+        out.update(extra)
+        return out
+
+    if compile_only:
+        # Compile-retry harness mode (scripts/bank_monolith.py): the AOT
+        # compile above already landed the executable in the persistent
+        # cache — no timed steps.
+        if tel is not None:
+            tel.emit("run_end", steps=0, ok=True)
+            tel.close()
+        return _result("compile_only", round(compile_s, 1), "s_compile")
+
+    # Warmup: one donated-state step + one steady-state step. The loss fetch
+    # (device->host transfer of an executable output) is the synchronization
+    # point: on tunneled TPU devices (axon), block_until_ready has been
+    # observed to return before queued executions finish, but a host transfer
+    # of an output scalar cannot complete until its executable does.
+    state, _ = compiled(state, batch_data)
+    state, metrics = compiled(state, batch_data)
     float(metrics["loss"])
 
     # Lagged fetch: sync step i-1's metrics while step i runs on-device, so
@@ -157,26 +194,30 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
     # bounds every step's completion (steady-state training throughput).
     t0 = time.perf_counter()
     prev = None
-    for _ in range(steps):
-        state, metrics = step(state, batch_data)
+    for i in range(steps):
+        td0 = time.perf_counter()
+        state, metrics = compiled(state, batch_data)
+        td1 = time.perf_counter()
         if prev is not None:
             float(prev["loss"])
+        tf1 = time.perf_counter()
         prev = metrics
+        if tel is not None:
+            tel.step(i + 1, data_wait_s=0.0, dispatch_s=td1 - td0,
+                     fetch_s=tf1 - td1, batch_size=batch)
     float(prev["loss"])
     dt = time.perf_counter() - t0
 
     pairs_per_sec = batch * steps / dt
     per_chip = pairs_per_sec / n_chips
-    return {
-        "metric": "sceneflow_train_throughput",
-        "value": round(per_chip, 3),
-        "unit": "pairs/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3),
-        "platform": platform,
-        "batch": batch,
-        "train_iters": train_iters,
-        "image_size": [h, w],
-    }
+    if tel is not None:
+        tel.throughput(per_chip, steps=steps, window_s=round(dt, 3))
+        tel.memory()
+        tel.emit("run_end", steps=steps, ok=True)
+        tel.close()
+    return _result(
+        "sceneflow_train_throughput", round(per_chip, 3), "pairs/sec/chip",
+        vs_baseline=round(per_chip / BASELINE_PAIRS_PER_SEC_PER_CHIP, 3))
 
 
 # The SceneFlow-recipe flagship shape (reference README.md:130 batch at
@@ -363,6 +404,37 @@ def _run_attempt_subprocess(kw, timeout_s=None):
     return result
 
 
+def _rotate_bench_run_dir():
+    """Rotate the chain's telemetry dir: runs/bench/current -> previous.
+
+    Every attempt child (which inherits ``BENCH_RUN_DIR``) appends its
+    schema events to ``current``; keeping the prior chain's log as
+    ``previous`` gives the rehearsal's regression gate
+    (``scripts/rehearse_round.py`` compare leg / obs/compare.py) its
+    baseline without any bookkeeping elsewhere. An externally-set
+    ``BENCH_RUN_DIR`` is respected untouched (harnesses that want their
+    own dir, e.g. tests).
+    """
+    if os.environ.get("BENCH_RUN_DIR"):
+        return os.environ["BENCH_RUN_DIR"]
+    import shutil
+    root = os.environ.get(
+        "BENCH_RUN_ROOT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "runs", "bench"))
+    current = os.path.join(root, "current")
+    previous = os.path.join(root, "previous")
+    try:
+        if os.path.isdir(current):
+            shutil.rmtree(previous, ignore_errors=True)
+            os.rename(current, previous)
+    except OSError as e:
+        print(f"bench run-dir rotation failed (continuing): {e}",
+              file=sys.stderr)
+    os.environ["BENCH_RUN_DIR"] = current
+    return current
+
+
 def _probe_on_tpu():
     """Platform probe in a child process, crash-proof: a wedged TPU-plugin
     import (the degraded environment this harness exists for) must not take
@@ -405,6 +477,7 @@ def main():
     # own wall clock counts against the deadline.
     t_start = time.monotonic()
     on_tpu = _probe_on_tpu()
+    _rotate_bench_run_dir()
     log_path = os.environ.get(
         "BENCH_ATTEMPTS_LOG",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -326,13 +326,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     * ``telemetry <run_dir>`` — summarize a run's events.jsonl + profiler
       trace (obs/summarize.py),
+    * ``compare <baseline> <candidate>`` — regression-gate two runs' event
+      logs (obs/compare.py; exit 1 on regression),
     * ``train`` / ``eval`` — the console entry points, for environments
       without the installed scripts.
     """
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    commands = ("telemetry", "train", "eval")
+    commands = ("telemetry", "compare", "train", "eval")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -341,6 +343,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "telemetry":
         from raft_stereo_tpu.obs.summarize import main as telemetry_main
         return telemetry_main(rest)
+    if cmd == "compare":
+        from raft_stereo_tpu.obs.compare import main as compare_main
+        return compare_main(rest)
     # _train_main/_eval_main parse sys.argv via argparse; present the
     # remainder as the whole command line
     sys.argv = [f"{sys.argv[0]} {cmd}"] + rest

@@ -235,7 +235,8 @@ class RAFTStereo(nn.Module):
     @nn.compact
     def __call__(self, image1, image2, iters: int = 12, flow_init=None,
                  test_mode: bool = False, flow_gt=None, loss_mask=None,
-                 stage: str = "full", enc_outs=None):
+                 stage: str = "full", enc_outs=None,
+                 iter_metrics: bool = False):
         """``flow_gt``/``loss_mask`` (both ``(B, H, W, 1)``) switch on the
         fused-loss training path: returns ``(per_iter_err_sums (iters,),
         final flow_up (B, H, W, 1))`` instead of the stacked predictions —
@@ -257,6 +258,15 @@ class RAFTStereo(nn.Module):
         The staged path is the SAME traced computation — ``"full"`` is
         exactly ``refine(encode(x))`` — so parameters, outputs, and
         gradients are identical up to XLA scheduling.
+
+        ``iter_metrics`` (test mode only): additionally return the
+        per-iteration mean |delta disparity| — an ``(iters,)`` in-graph aux
+        output measuring how much each GRU iteration still moves the field
+        (the convergence axis of the serial-floor decomposition,
+        scripts/serial_floor.py). Computed from consecutive carries, so the
+        scanned graph gains one tiny reduction per iteration and nothing
+        else changes; the return becomes ``(flow_lowres, flow_up,
+        delta_norms)``.
         """
         cfg = self.cfg
         dt = self.compute_dtype
@@ -264,7 +274,7 @@ class RAFTStereo(nn.Module):
         if stage == "refine":
             cnet_list, fmap1, fmap2 = enc_outs
             return self._refine(cnet_list, fmap1, fmap2, iters, flow_init,
-                                test_mode, flow_gt, loss_mask)
+                                test_mode, flow_gt, loss_mask, iter_metrics)
 
         image1 = (2.0 * (image1 / 255.0) - 1.0).astype(jnp.float32)
         image2 = (2.0 * (image2 / 255.0) - 1.0).astype(jnp.float32)
@@ -355,10 +365,10 @@ class RAFTStereo(nn.Module):
         if stage == "encode":
             return tuple(cnet_list), fmap1, fmap2
         return self._refine(tuple(cnet_list), fmap1, fmap2, iters, flow_init,
-                            test_mode, flow_gt, loss_mask)
+                            test_mode, flow_gt, loss_mask, iter_metrics)
 
     def _refine(self, cnet_list, fmap1, fmap2, iters, flow_init, test_mode,
-                flow_gt, loss_mask):
+                flow_gt, loss_mask, iter_metrics=False):
         """Post-encoder forward: context processing, correlation pyramid, the
         refinement scan, and the upsample/loss tail. Called from the compact
         ``__call__`` (both the monolithic and staged paths)."""
@@ -367,6 +377,9 @@ class RAFTStereo(nn.Module):
             # reads the in-loop mask); make the contract explicit rather
             # than returning an unrefined or once-refined field.
             raise ValueError(f"iters must be >= 1, got {iters}")
+        if iter_metrics and not test_mode:
+            raise ValueError("iter_metrics aux outputs exist on the "
+                             "test_mode (inference) scan only")
         cfg = self.cfg
         dt = self.compute_dtype
 
@@ -446,23 +459,38 @@ class RAFTStereo(nn.Module):
             carry = (tuple(net_list), coords1)
 
             def scan_iter(mdl, c, _):
-                c, _unused = mdl(c, corr_state, tuple(inp_list), coords0,
-                                 None, compute_mask=False)
-                return c, None
+                c2, _unused = mdl(c, corr_state, tuple(inp_list), coords0,
+                                  None, compute_mask=False)
+                # per-iteration mean |delta disparity| from consecutive
+                # carries — the convergence aux of iter_metrics; None keeps
+                # the default graph byte-identical
+                y = (jnp.mean(jnp.abs((c2[1] - c[1])[..., 0]))
+                     if iter_metrics else None)
+                return c2, y
 
+            delta_norms = None
             if iters > 1:
-                carry, _ = nn.scan(
+                carry, scanned_norms = nn.scan(
                     scan_iter,
                     variable_broadcast="params",
                     split_rngs={"params": False},
                     length=iters - 1,
                     unroll=cfg.scan_unroll,
                 )(refine, carry, None)
+                if iter_metrics:
+                    delta_norms = scanned_norms
+            pre_final = carry
             carry, mask = refine(carry, corr_state, tuple(inp_list), coords0,
                                  None)
             coords1 = carry[1]
             flow_up = upsample_disparity_convex(coords1 - coords0, mask,
                                                 cfg.factor)
+            if iter_metrics:
+                final_norm = jnp.mean(
+                    jnp.abs((carry[1] - pre_final[1])[..., 0]))[None]
+                delta_norms = (final_norm if delta_norms is None else
+                               jnp.concatenate([delta_norms, final_norm]))
+                return coords1 - coords0, flow_up, delta_norms
             return coords1 - coords0, flow_up
         if fused and not deferred:
             carry = (tuple(net_list), coords1,

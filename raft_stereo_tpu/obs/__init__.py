@@ -10,18 +10,29 @@ The three pieces every entry point shares:
   log and the measurement harnesses;
 * the summarizer (obs/summarize.py) — ``python -m raft_stereo_tpu.cli
   telemetry <run_dir>`` merges events.jsonl with a ``jax.profiler`` trace
-  into one report.
+  into one report;
+* compiled-artifact introspection (obs/xla.py) —
+  :func:`introspect_compiled` turns every ``lower().compile()`` site's
+  memory/cost analyses into ``xla_memory``/``xla_cost`` events;
+* the regression gate (obs/compare.py) — ``python -m raft_stereo_tpu.cli
+  compare <baseline> <candidate>`` diffs two runs' event logs against
+  thresholds and exits non-zero on regression.
 """
 
 from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
+                                        SUPPORTED_SCHEMA_VERSIONS,
                                         append_json_log, make_record,
                                         read_events, validate_events,
                                         validate_record)
 from raft_stereo_tpu.obs.telemetry import Telemetry
 from raft_stereo_tpu.obs.summarize import format_summary, summarize_run
+from raft_stereo_tpu.obs.xla import (compact_xla_summary,
+                                     introspect_compiled)
+from raft_stereo_tpu.obs.compare import compare_runs
 
 __all__ = [
-    "EVENT_TYPES", "SCHEMA_VERSION", "append_json_log", "make_record",
-    "read_events", "validate_events", "validate_record", "Telemetry",
-    "format_summary", "summarize_run",
+    "EVENT_TYPES", "SCHEMA_VERSION", "SUPPORTED_SCHEMA_VERSIONS",
+    "append_json_log", "make_record", "read_events", "validate_events",
+    "validate_record", "Telemetry", "format_summary", "summarize_run",
+    "introspect_compiled", "compact_xla_summary", "compare_runs",
 ]

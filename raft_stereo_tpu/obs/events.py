@@ -27,7 +27,21 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+# Back-compat: every schema version whose artifacts are still readable.
+# v1 -> v2 was purely ADDITIVE (the xla_memory/xla_cost introspection
+# events; no v1 event changed its required fields), so pre-existing
+# runs/*/events.jsonl lint clean — a v1 record is validated against the
+# v1 surface (it just may not use events introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2)
+
+# Events introduced after schema v1; a record stamped with an older schema
+# than its event's introduction is drift (a writer forgot the bump).
+_EVENT_MIN_VERSION: Dict[str, int] = {
+    "xla_memory": 2,
+    "xla_cost": 2,
+}
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
 # are fine; missing ones are schema drift (caught by validate_record and the
@@ -48,6 +62,14 @@ EVENT_TYPES: Dict[str, tuple] = {
     # Streaming-eval pipeline gauge (eval/stream.py): device dispatches
     # currently in flight; `window`/`microbatch` ride along as extras.
     "pipeline": ("in_flight",),
+    # Compiled-artifact introspection (obs/xla.py), one record per
+    # lower().compile() site: executable memory footprint from XLA's
+    # memory_analysis (peak_bytes = arguments + outputs + temps + generated
+    # code - aliased; capacity/headroom ride along where the backend
+    # reports a bytes_limit) and the HLO cost model (flops, bytes
+    # accessed, flops_per_byte).
+    "xla_memory": ("source", "peak_bytes"),
+    "xla_cost": ("source", "flops"),
     "stall": ("seconds_since_step", "deadline_s"),
     "error": ("error",),
     "run_end": ("steps",),
@@ -73,14 +95,20 @@ def validate_record(rec: Any) -> List[str]:
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
     errors: List[str] = []
-    if rec.get("schema") != SCHEMA_VERSION:
-        errors.append(f"schema {rec.get('schema')!r} != {SCHEMA_VERSION}")
+    ver = rec.get("schema")
+    if ver not in SUPPORTED_SCHEMA_VERSIONS:
+        errors.append(f"schema {ver!r} not in supported versions "
+                      f"{SUPPORTED_SCHEMA_VERSIONS}")
     if not isinstance(rec.get("ts"), str):
         errors.append("missing/non-string ts")
     event = rec.get("event")
     if event not in EVENT_TYPES:
         errors.append(f"unknown event {event!r}")
         return errors
+    if (isinstance(ver, int)
+            and ver < _EVENT_MIN_VERSION.get(event, 1)):
+        errors.append(f"{event}: introduced in schema "
+                      f"{_EVENT_MIN_VERSION[event]}, record claims {ver}")
     for field in EVENT_TYPES[event]:
         if field not in rec:
             errors.append(f"{event}: missing required field {field!r}")

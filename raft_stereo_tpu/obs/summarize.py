@@ -100,6 +100,31 @@ def _pipeline_gauges(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _xla_summary(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Headline numbers from the compiled-artifact introspection records
+    (obs/xla.py): the LAST xla_memory/xla_cost event of the log — for a
+    bench chain that is the final (banked) attempt's executable."""
+    mems = [e for e in events if e.get("event") == "xla_memory"]
+    costs = [e for e in events if e.get("event") == "xla_cost"]
+    if not mems and not costs:
+        return None
+    out: Dict[str, Any] = {"n_memory": len(mems), "n_cost": len(costs)}
+    if mems:
+        m = mems[-1]
+        out["source"] = m.get("source")
+        for k in ("peak_bytes", "temp_bytes", "argument_bytes",
+                  "output_bytes", "capacity_bytes", "headroom_bytes"):
+            if k in m:
+                out[k] = m[k]
+    if costs:
+        c = costs[-1]
+        out.setdefault("source", c.get("source"))
+        for k in ("flops", "bytes_accessed", "flops_per_byte"):
+            if k in c:
+                out[k] = c[k]
+    return out
+
+
 def _find_trace_dir(run_dir: str) -> Optional[str]:
     hits = glob.glob(os.path.join(run_dir, "**", "plugins", "profile"),
                      recursive=True)
@@ -145,6 +170,7 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "throughput_trend": _throughput_trend(steps),
         "pipeline_overlap": _pipeline_overlap(steps),
         "pipeline": _pipeline_gauges(events),
+        "xla": _xla_summary(events),
         "compiles": {
             "count": len(by("compile")),
             "total_s": round(sum(e.get("duration_s", 0.0)
@@ -212,6 +238,27 @@ def format_summary(report: Dict[str, Any]) -> str:
                                if k in pg)
             lines.append(f"pipeline gauges: {pg['gauges']} ({depth}"
                          + (f", {extras}" if extras else "") + ")")
+        xl = ev.get("xla")
+        if xl:
+            gib = 1024 ** 3
+            parts = []
+            if "peak_bytes" in xl:
+                peak = f"peak {xl['peak_bytes'] / gib:.2f} GiB"
+                if "capacity_bytes" in xl:
+                    peak += (f" of {xl['capacity_bytes'] / gib:.1f} GiB "
+                             f"(headroom "
+                             f"{xl['headroom_bytes'] / gib:.2f} GiB)")
+                if "temp_bytes" in xl:
+                    peak += f", temps {xl['temp_bytes'] / gib:.2f} GiB"
+                parts.append(peak)
+            if "flops" in xl:
+                cost = f"{xl['flops']:.3g} flops"
+                if "flops_per_byte" in xl:
+                    cost += f", {xl['flops_per_byte']} flops/byte"
+                parts.append(cost)
+            lines.append("")
+            lines.append(f"xla executable ({xl.get('source')}): "
+                         + "; ".join(parts))
         c = ev["compiles"]
         lines.append("")
         lines.append(f"compiles: {c['count']} ({c['total_s']} s)")

@@ -1,0 +1,264 @@
+"""Compiled-artifact introspection: XLA memory/cost analysis as events.
+
+The profiler traces (utils/profiling.py) answer "where did device time go";
+this module answers the *other* two device-side questions a run leaves open:
+
+* **How much memory does the executable need, and how close is that to the
+  chip?** ``jax.stages.Compiled.memory_analysis()`` reports the executable's
+  argument / output / temp / generated-code footprint at buffer-assignment
+  time — BEFORE anything runs, so an AOT-OOM recipe can be diagnosed without
+  surviving it, and a "spill regime" claim can be checked against the
+  actual temp residency instead of hypothesized.
+* **What does the compiled graph cost?** ``cost_analysis()`` exposes XLA's
+  HLO cost model (flops, bytes accessed): flops/byte is the executable's
+  arithmetic intensity — the number that says whether a recipe is compute-
+  or bandwidth-bound before a profiler ever attaches.
+
+:func:`introspect_compiled` turns both into schema events (``xla_memory`` /
+``xla_cost``, obs/events.py v2) on a run's ``events.jsonl``, so every
+``lower().compile()`` site (bench.py's attempt chain, the trainer's first
+step, scripts/profile_step.py, scripts/batch_frontier.py rows) leaves a
+machine-readable record the summarizer and the compare gate can read.
+
+For *naming* buffers (which allocation dominates the temp footprint — the
+question VERDICT r5 weak #4 asks about the b10 collapse), XLA's
+buffer-assignment dump is the ground truth: run the compile in a process
+with ``XLA_FLAGS=--xla_dump_to=<dir>`` and feed the resulting
+``*buffer-assignment.txt`` to :func:`summarize_buffer_assignment`
+(scripts/alloc_breakdown.py drives this end to end). The analyses are
+backend-generic — on CPU the "device" numbers describe host buffers, which
+is still the same HLO module and buffer shapes as the TPU executable; only
+layouts and the capacity line differ. Everything here is fail-open: an
+introspection API moving under a jax upgrade must never take down the run
+it observes.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+# CompiledMemoryStats attribute -> short event-field name. host_* mirrors
+# (CPU-offload sizes) are folded in only when non-zero.
+_MEMORY_FIELDS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+}
+
+
+def memory_analysis_dict(compiled) -> Optional[Dict[str, int]]:
+    """``compiled.memory_analysis()`` as a plain dict, or None.
+
+    Adds ``peak_bytes`` — the executable's device residency while it runs:
+    arguments + outputs + temps + generated code, minus buffers aliased
+    into arguments (donation). This is the number to hold against the
+    chip's ``bytes_limit``.
+    """
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out: Dict[str, int] = {}
+    for attr, name in _MEMORY_FIELDS.items():
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[name] = int(v)
+    if not out:
+        return None
+    out["peak_bytes"] = (out.get("argument_bytes", 0)
+                         + out.get("output_bytes", 0)
+                         + out.get("temp_bytes", 0)
+                         + out.get("generated_code_bytes", 0)
+                         - out.get("alias_bytes", 0))
+    return out
+
+
+def cost_analysis_dict(compiled) -> Optional[Dict[str, float]]:
+    """``compiled.cost_analysis()`` flattened to scalar properties, or None.
+
+    Keeps the module-level totals (``flops``, ``bytes accessed``,
+    ``transcendentals``, ``optimal_seconds``) and derives ``flops_per_byte``
+    (arithmetic intensity); the per-operand keys XLA also emits
+    (``bytes accessed0{}`` ...) are dropped.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out: Dict[str, float] = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("transcendentals", "transcendentals"),
+                      ("optimal_seconds", "optimal_seconds")):
+        v = ca.get(key)
+        if isinstance(v, (int, float)) and v == v:  # drop NaN sentinels
+            out[name] = float(v)
+    if "flops" not in out:
+        return None
+    if out.get("bytes_accessed"):
+        out["flops_per_byte"] = round(out["flops"] / out["bytes_accessed"], 4)
+    return out
+
+
+def device_capacity_bytes(device=None) -> Optional[int]:
+    """The backend's per-device memory capacity (``bytes_limit``), or None
+    where the backend doesn't report one (XLA-CPU)."""
+    try:
+        import jax
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+    except Exception:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def introspect_compiled(compiled, telemetry=None, source: str = "compiled",
+                        extra: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Optional[Dict[str, Any]]]:
+    """Extract memory + cost analyses; emit ``xla_memory``/``xla_cost``.
+
+    Returns ``{"memory": ..., "cost": ...}`` (either half None where the
+    backend provides nothing). When ``telemetry`` is given, each available
+    half becomes one schema event with ``source`` naming the compile site;
+    ``extra`` fields (batch, recipe tag, ...) ride along on both.
+    """
+    mem = memory_analysis_dict(compiled)
+    cost = cost_analysis_dict(compiled)
+    if mem is not None:
+        cap = device_capacity_bytes()
+        if cap:
+            mem["capacity_bytes"] = cap
+            mem["headroom_bytes"] = cap - mem["peak_bytes"]
+    if telemetry is not None:
+        if mem is not None:
+            telemetry.emit("xla_memory", source=source, **mem,
+                           **(extra or {}))
+        if cost is not None:
+            telemetry.emit("xla_cost", source=source, **cost,
+                           **(extra or {}))
+    return {"memory": mem, "cost": cost}
+
+
+def compact_xla_summary(analysis: Dict[str, Optional[Dict[str, Any]]]
+                        ) -> Optional[Dict[str, Any]]:
+    """The two headline numbers (peak bytes, flops/byte) for result JSONs."""
+    mem, cost = analysis.get("memory"), analysis.get("cost")
+    out: Dict[str, Any] = {}
+    if mem:
+        out["peak_bytes"] = mem["peak_bytes"]
+        out["temp_bytes"] = mem.get("temp_bytes")
+        if "headroom_bytes" in mem:
+            out["headroom_bytes"] = mem["headroom_bytes"]
+    if cost:
+        out["flops"] = cost["flops"]
+        if "flops_per_byte" in cost:
+            out["flops_per_byte"] = cost["flops_per_byte"]
+    return out or None
+
+
+# --- buffer-assignment dumps ------------------------------------------------
+#
+# Line shapes in an XLA *buffer-assignment.txt (any backend):
+#   allocation 6: size 16452, preallocated-temp:
+#    value: <9 dot.4 @0> (size=16384,offset=0): f32[64,64]{1,0}
+#   Total bytes used: 49236 (48.1KiB)
+
+_ALLOC_RE = re.compile(r"^allocation (\d+): size (\d+), (.+?):?$")
+_VALUE_RE = re.compile(
+    r"^\s+value: <\d+ (\S+) @\S+> \(size=(\d+),offset=(\d+)\): (\S+)")
+_TOTAL_RE = re.compile(r"^Total bytes used: (\d+)")
+
+
+def _alloc_kind(desc: str) -> str:
+    for kind in ("preallocated-temp", "parameter", "constant",
+                 "thread-local"):
+        if kind in desc:
+            return "temp" if kind == "preallocated-temp" else kind
+    return desc.split(",")[0].strip()
+
+
+def parse_buffer_assignment(text: str) -> Dict[str, Any]:
+    """Parse XLA's ``*buffer-assignment.txt`` dump into allocations.
+
+    Returns ``{"total_bytes", "allocations": [{"index", "size", "kind",
+    "maybe_live_out", "values": [{"instruction", "size", "offset",
+    "shape"}]}]}``. Only the leading BufferAssignment section is read (the
+    "Used values" tail repeats every value with its uses).
+    """
+    allocations: List[Dict[str, Any]] = []
+    total = None
+    cur: Optional[Dict[str, Any]] = None
+    for line in text.splitlines():
+        m = _TOTAL_RE.match(line)
+        if m:
+            total = int(m.group(1))
+            break  # end of the assignment section
+        m = _ALLOC_RE.match(line)
+        if m:
+            desc = m.group(3)
+            cur = {"index": int(m.group(1)), "size": int(m.group(2)),
+                   "kind": _alloc_kind(desc),
+                   "maybe_live_out": "maybe-live-out" in desc,
+                   "values": []}
+            allocations.append(cur)
+            continue
+        m = _VALUE_RE.match(line)
+        if m and cur is not None:
+            cur["values"].append({"instruction": m.group(1),
+                                  "size": int(m.group(2)),
+                                  "offset": int(m.group(3)),
+                                  "shape": m.group(4)})
+    return {"total_bytes": total, "allocations": allocations}
+
+
+def summarize_buffer_assignment(text: str, top: int = 8) -> Dict[str, Any]:
+    """Name the buffers that matter: top allocations by size, and inside the
+    dominant temp allocation the largest values (HLO instruction + shape) —
+    the answer to "WHICH buffer is the big one", which the aggregate
+    ``memory_analysis`` totals cannot give."""
+    parsed = parse_buffer_assignment(text)
+    allocs = sorted(parsed["allocations"], key=lambda a: -a["size"])
+    temp_allocs = [a for a in allocs if a["kind"] == "temp"]
+    dominant = None
+    if temp_allocs:
+        biggest = temp_allocs[0]
+        values = sorted(biggest["values"], key=lambda v: -v["size"])[:top]
+        dominant = {
+            "allocation": biggest["index"],
+            "size": biggest["size"],
+            "top_values": [{"instruction": v["instruction"],
+                            "shape": v["shape"], "size": v["size"]}
+                           for v in values],
+        }
+    return {
+        "total_bytes": parsed["total_bytes"],
+        "temp_bytes": sum(a["size"] for a in temp_allocs),
+        "top_allocations": [
+            {"index": a["index"], "size": a["size"], "kind": a["kind"],
+             "n_values": len(a["values"])}
+            for a in allocs[:top]
+        ],
+        "dominant_temp": dominant,
+    }
+
+
+def find_buffer_assignment(dump_dir: str) -> Optional[str]:
+    """Pick the main module's buffer-assignment file from an
+    ``--xla_dump_to`` directory (the largest one — jit wrapper modules for
+    convert/broadcast ops dump alongside the real graph)."""
+    paths = glob.glob(os.path.join(dump_dir, "*buffer-assignment.txt"))
+    if not paths:
+        return None
+    return max(paths, key=os.path.getsize)

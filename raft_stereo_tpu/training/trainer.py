@@ -57,6 +57,28 @@ def _restore(path: str, state: TrainState, model_cfg: RAFTStereoConfig,
     return restored
 
 
+def _compile_step_introspected(step_fn, state, placed, tel):
+    """AOT-compile the train step and record its XLA memory/cost analyses.
+
+    ``lower().compile()`` builds the SAME executable (and persistent-cache
+    key) the first jitted dispatch would, but hands back the compiled
+    object, whose ``memory_analysis()``/``cost_analysis()`` become
+    ``xla_memory``/``xla_cost`` events — peak-HBM headroom and flops/byte
+    are on the run record before the first step executes. Fail-open: any
+    AOT/introspection failure falls back to the plain jitted callable (one
+    logged warning), because observability must never take down the run.
+    """
+    try:
+        compiled = step_fn.lower(state, placed).compile()
+        from raft_stereo_tpu.obs.xla import introspect_compiled
+        introspect_compiled(compiled, tel, source="train_step")
+        return compiled
+    except Exception:
+        logger.warning("AOT step introspection failed; falling back to "
+                       "jit dispatch", exc_info=True)
+        return step_fn
+
+
 def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
           validate_every: Optional[int] = None) -> str:
     """Run training to ``cfg.num_steps``; returns the final checkpoint path."""
@@ -114,13 +136,17 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         global_step = start_step = int(state.step)
         pending = None  # lagged metrics fetch: sync step i-1 while i runs
         batches = infinite_batches(loader)
+        step_impl = None  # AOT-compiled on the first batch (shapes known)
         try:
             while global_step < cfg.num_steps:
                 t0 = time.perf_counter()
                 batch = next(batches)
                 t1 = time.perf_counter()
                 placed = shard_batch(mesh, batch)
-                state, metrics = step_fn(state, placed)
+                if step_impl is None:
+                    step_impl = _compile_step_introspected(
+                        step_fn, state, placed, tel)
+                state, metrics = step_impl(state, placed)
                 t2 = time.perf_counter()
                 if pending is not None:
                     log.push({k: float(v) for k, v in pending.items()},

@@ -118,6 +118,91 @@ def summarize_trace(log_dir: str, top: int = 25) -> Dict[str, Any]:
     }
 
 
+# --- serial-floor decomposition ---------------------------------------------
+#
+# The refinement loop (the lax.scan over GRU iterations — the serial hot
+# path this repo exists to accelerate) contributes a batch-independent
+# ~450 ms floor to the train step (PERF.md). Aggregate traces show THAT the
+# scan dominates; these helpers split the floor per iteration: time the
+# same graph at several iteration counts and fit wall time = fixed +
+# per_iter * iters. Run the sweep twice — rolled (scan) and fully unrolled
+# (scan_unroll=iters, XLA free to fuse across iteration boundaries) — and
+# the rolled-minus-unrolled slope isolates the loop/layout overhead each
+# iteration pays for being inside a `while` (carry relayouts, loop
+# bookkeeping) from the GRU/lookup compute itself; the intercept is the
+# per-call fixed work (encoders, volume build, upsample tail, host
+# dispatch). scripts/serial_floor.py drives this end to end.
+
+def fit_linear(xs: List[float], ys: List[float]) -> tuple:
+    """Least-squares fit ``y = slope * x + intercept``; returns
+    ``(slope, intercept)``. Needs >= 2 distinct x values."""
+    import numpy as np
+    x = np.asarray(xs, dtype=np.float64)
+    y = np.asarray(ys, dtype=np.float64)
+    if len(x) < 2 or np.ptp(x) == 0:
+        raise ValueError("fit_linear needs >= 2 distinct x samples")
+    slope, intercept = np.polyfit(x, y, 1)
+    return float(slope), float(intercept)
+
+
+def time_compiled(fn, args, repeats: int = 3, warmup: int = 1) -> float:
+    """Best-of-``repeats`` wall seconds for ``fn(*args)``.
+
+    Synchronizes by materializing every output to host (``jax.device_get``)
+    — the fetch-an-output sync that works on tunneled TPUs where
+    ``block_until_ready`` can return early (see module doc)."""
+    import time as _time
+
+    import jax
+
+    for _ in range(max(warmup, 0)):
+        jax.device_get(fn(*args))
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = _time.perf_counter()
+        jax.device_get(fn(*args))
+        best = min(best, _time.perf_counter() - t0)
+    return best
+
+
+def decompose_serial_floor(rolled: Dict[int, float],
+                           unrolled: Optional[Dict[int, float]] = None
+                           ) -> Dict[str, Any]:
+    """Split iteration-sweep timings into fixed / compute / loop-overhead.
+
+    ``rolled`` maps iteration count -> wall seconds for the scanned graph;
+    ``unrolled`` (optional) the same for the fully-unrolled graph. Returns
+    per-iteration and fixed components in seconds:
+
+    * ``fixed_s`` — the rolled fit's intercept: per-call work independent
+      of iteration count (encoders + volume build + post-scan tail + host
+      dispatch);
+    * ``per_iter_s`` — the rolled fit's slope: what one more GRU iteration
+      costs end to end;
+    * ``per_iter_compute_s`` — the unrolled slope: the iteration's
+      compute with XLA free to fuse across iterations (no loop carry);
+    * ``per_iter_loop_overhead_s`` — rolled minus unrolled slope: the
+      layout/bookkeeping cost of living inside the ``while`` — the share
+      of the floor that is NOT algorithmic serial dependency.
+    """
+    its = sorted(rolled)
+    slope, intercept = fit_linear(its, [rolled[i] for i in its])
+    out: Dict[str, Any] = {
+        "samples": {str(i): round(rolled[i], 6) for i in its},
+        "fixed_s": round(intercept, 6),
+        "per_iter_s": round(slope, 6),
+    }
+    if unrolled:
+        uits = sorted(unrolled)
+        uslope, uintercept = fit_linear(uits, [unrolled[i] for i in uits])
+        out["unrolled_samples"] = {str(i): round(unrolled[i], 6)
+                                   for i in uits}
+        out["unrolled_fixed_s"] = round(uintercept, 6)
+        out["per_iter_compute_s"] = round(uslope, 6)
+        out["per_iter_loop_overhead_s"] = round(slope - uslope, 6)
+    return out
+
+
 def format_report(report: Dict[str, Any]) -> str:
     lines: List[str] = [
         f"trace: {report['trace']}",

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""AOT allocation breakdown: name the buffers behind a recipe's residency.
+
+``memory_analysis()`` totals (obs/xla.py) say HOW MUCH an executable needs;
+this harness says WHICH buffers — the question VERDICT r5 weak #4 asks about
+the b10/b12 step-time collapse ("XLA buffer-assignment falling into a spill
+regime" was hypothesized with no allocation breakdown behind it). It
+compiles EXACTLY the bench attempt's graph (bench.py ``--attempt`` with
+``compile_only``, so the persistent-cache key matches the timed attempt) in
+a subprocess whose ``XLA_FLAGS=--xla_dump_to`` captures the
+buffer-assignment dump, then parses the dump into a named breakdown: top
+allocations by size and, inside the dominant temp allocation, the largest
+HLO values (instruction + shape) — the concrete buffer a spill claim must
+name.
+
+Artifacts under ``--out`` (default ``runs/alloc_b<batch>_<schedule>``):
+
+* ``analysis.json`` — config, compile result, memory_analysis totals, and
+  the named breakdown;
+* ``events.jsonl`` — the child's xla_memory/xla_cost introspection events
+  (``BENCH_RUN_DIR`` is pointed at the artifact dir);
+* ``memory-usage-report.txt`` — XLA's own sorted-allocation report, kept
+  verbatim (the raw dump is pruned unless ``--keep-dump``: the optimized-
+  HLO text for the flagship graph runs to hundreds of MB).
+
+Run: python scripts/alloc_breakdown.py --batch 10 --schedule frugal
+     [--h 320 --w 720] [--timeout 1500]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import (  # noqa: E402  (no jax at module level)
+    FLAGSHIP_RECIPE, run_attempt_subprocess_detailed)
+from raft_stereo_tpu.config import R4_BEST_SCHEDULE  # noqa: E402
+from raft_stereo_tpu.obs.xla import (  # noqa: E402
+    find_buffer_assignment, summarize_buffer_assignment)
+
+SCHEDULES = {
+    # the bench banker: hi-res-only block remat + the r4 best schedule
+    "banker": dict(remat_encoders="blocks_hires", **R4_BEST_SCHEDULE),
+    # the memory-frugal fallback the >b8 frontier rows ran on
+    "frugal": dict(remat_encoders=True),
+    # the no-remat monolith (the primary attempt's graph)
+    "monolith": dict(**R4_BEST_SCHEDULE),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=10)
+    p.add_argument("--schedule", choices=sorted(SCHEDULES), default="frugal")
+    p.add_argument("--dtype", choices=["bfloat16", "float32"],
+                   default="bfloat16")
+    p.add_argument("--h", type=int, default=FLAGSHIP_RECIPE["h"])
+    p.add_argument("--w", type=int, default=FLAGSHIP_RECIPE["w"])
+    p.add_argument("--train_iters", type=int,
+                   default=FLAGSHIP_RECIPE["train_iters"])
+    p.add_argument("--timeout", type=float, default=1500.0)
+    p.add_argument("--out", default=None)
+    p.add_argument("--top", type=int, default=10)
+    p.add_argument("--keep-dump", action="store_true")
+    args = p.parse_args()
+
+    out = args.out or os.path.join(
+        REPO, "runs", f"alloc_b{args.batch}_{args.schedule}")
+    dump_dir = os.path.join(out, "xla_dump")
+    os.makedirs(dump_dir, exist_ok=True)
+
+    kw = dict(batch=args.batch, h=args.h, w=args.w,
+              train_iters=args.train_iters, steps=1, fused_loss=True,
+              corr_storage_dtype=args.dtype, compile_only=True,
+              **SCHEDULES[args.schedule])
+
+    # the child inherits env: route the dump + the introspection events to
+    # the artifact dir; restore afterwards so nothing leaks into later use
+    saved = {k: os.environ.get(k) for k in ("XLA_FLAGS", "BENCH_RUN_DIR",
+                                            "JAX_COMPILATION_CACHE_DIR")}
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_dump_to={dump_dir} "
+        + (saved["XLA_FLAGS"] or "")).strip()
+    os.environ["BENCH_RUN_DIR"] = out
+    # a cache hit would skip compilation — and the dump with it
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(dump_dir, "cache")
+    try:
+        result, err, wall = run_attempt_subprocess_detailed(kw, args.timeout)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    ba_path = find_buffer_assignment(dump_dir)
+    breakdown = None
+    if ba_path is not None:
+        with open(ba_path) as f:
+            breakdown = summarize_buffer_assignment(f.read(), top=args.top)
+    report = {
+        "config": kw,
+        "ok": result is not None,
+        "compile_s": None if result is None else result["value"],
+        "platform": None if result is None else result.get("platform"),
+        "xla": None if result is None else result.get("xla"),
+        "error": None if err is None else err[:400],
+        "wall_s": round(wall, 1),
+        "buffer_assignment": breakdown,
+    }
+    with open(os.path.join(out, "analysis.json"), "w") as f:
+        json.dump(report, f, indent=1)
+
+    # keep XLA's own compact report FOR THE ANALYZED MODULE (same dump
+    # prefix as its buffer-assignment file — wrapper modules for trivial
+    # ops dump alongside); prune the multi-hundred-MB HLO text
+    if ba_path is not None:
+        report_path = ba_path.replace("buffer-assignment.txt",
+                                      "memory-usage-report.txt")
+        if os.path.exists(report_path):
+            shutil.copy(report_path,
+                        os.path.join(out, "memory-usage-report.txt"))
+    if not args.keep_dump:
+        shutil.rmtree(dump_dir, ignore_errors=True)
+
+    if breakdown is None:
+        print(f"no buffer-assignment dump captured "
+              f"(error: {report['error']})", file=sys.stderr)
+        print(json.dumps({k: report[k] for k in
+                          ("ok", "compile_s", "error", "wall_s")}))
+        return 1
+    gib = 1024 ** 3
+    dom = breakdown["dominant_temp"]
+    print(f"b{args.batch} {args.schedule} ({args.dtype}) "
+          f"{args.h}x{args.w}x{args.train_iters}it — "
+          f"total {breakdown['total_bytes'] / gib:.2f} GiB, "
+          f"temps {breakdown['temp_bytes'] / gib:.2f} GiB")
+    if dom:
+        print(f"dominant temp allocation: {dom['size'] / gib:.2f} GiB; "
+              f"largest values:")
+        for v in dom["top_values"]:
+            print(f"  {v['size'] / gib:8.3f} GiB  {v['shape']:28s} "
+                  f"{v['instruction'][:70]}")
+    print(f"artifact: {out}/analysis.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

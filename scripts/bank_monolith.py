@@ -70,6 +70,9 @@ def main():
         _log({"window": window, "target": "monolith",
               "ok": result is not None,
               "compile_s": None if result is None else result["value"],
+              # a CPU-host probe proves the harness, not the TPU helper —
+              # the platform on record keeps the two kinds of window apart
+              "platform": None if result is None else result.get("platform"),
               "error": None if err is None else err[:400],
               "wall_s": round(dt, 1)})
         banked = result is not None

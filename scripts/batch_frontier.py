@@ -66,11 +66,19 @@ def main():
                           **RECIPE)
                 result, err, wall = run_attempt_subprocess_detailed(
                     kw, args.timeout)
+                # the attempt's compiled-artifact introspection (bench.py
+                # AOT path, obs/xla.py) rides every row: peak/temp bytes
+                # say WHY a batch stops fitting, flops/byte whether the
+                # ladder left the compute-bound regime
+                xla = (result or {}).get("xla") or {}
                 _log({"batch": b, "schedule": name,
                       "corr_storage_dtype": dtype,
                       "ok": result is not None,
                       "pairs_per_sec":
                           None if result is None else result["value"],
+                      "xla_peak_bytes": xla.get("peak_bytes"),
+                      "xla_temp_bytes": xla.get("temp_bytes"),
+                      "xla_flops_per_byte": xla.get("flops_per_byte"),
                       "error": None if err is None else err[:300],
                       "wall_s": round(wall, 1)})
                 if result is not None:

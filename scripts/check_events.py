@@ -2,12 +2,18 @@
 """Schema lint for events.jsonl artifacts (obs/events.py).
 
 Validates every record of one or more ``events.jsonl`` files (or run
-directories containing one) against the current ``SCHEMA_VERSION`` and each
-event type's required fields — including the streaming-eval ``pipeline``
-gauge (``in_flight`` required, obs/events.py) — and exits non-zero on any
-violation; wired into the tier-1 run via tests/test_telemetry.py and
-tests/test_eval_stream.py so schema drift fails tests instead of silently
+directories containing one) against the supported schema versions and each
+event type's required fields — the streaming-eval ``pipeline`` gauge
+(``in_flight`` required) and the v2 compiled-artifact introspection records
+``xla_memory`` (``source``/``peak_bytes``) and ``xla_cost``
+(``source``/``flops``), which additionally may not claim a schema older
+than their introduction — and exits non-zero on any violation; wired into
+the tier-1 run via tests/test_telemetry.py, tests/test_eval_stream.py and
+tests/test_obs_xla.py so schema drift fails tests instead of silently
 corrupting downstream summarizers.
+
+Back-compat: v1 -> v2 was additive (obs/events.py
+``SUPPORTED_SCHEMA_VERSIONS``), so pre-existing v1 artifacts lint clean.
 
 Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
 """

@@ -19,15 +19,20 @@ training loop runs in both frameworks and the trajectories are compared:
    pct_start 0.01 over N+100, global-norm clip 1.0), fp32 on CPU.
 3. Compare per-step loss trajectories (windowed means) and the final models'
    EPE on held-out pairs, each framework evaluating its OWN trained weights
-   natively. GATE: last-window loss deviation within --tolerance (default
-   2%) — the training-dynamics criterion. Final EPE over a few pairs is
-   chaos-dominated and is reported, not gated: judge it against the
-   same-framework floor that ``--mode null`` measures (torch trained twice
-   from a 1e-6-perturbed init deviates 8.0% EPE / 3.4% loss at 300 steps
-   — larger than the cross-framework deviation on both axes).
+   natively. GATE (the null-floor rule, VERDICT r5 weak #3): pass ⇔ the
+   cross-framework deviation is within the measured SAME-framework floor —
+   the ``--mode null`` run's JSON (torch trained twice from a
+   1e-6-perturbed init) is taken as input (``--null``, default
+   ``runs/parity_dynamics_null.json``) and both axes are gated against it:
+   last-window loss deviation ≤ the null run's, final-EPE deviation ≤ the
+   null run's. Two trainings of the same framework cannot be expected to
+   land closer than that floor, so a cross-framework drift under it IS
+   parity — machine-checked now, not narrated. Without a null JSON the
+   gate falls back to the fixed ``--tolerance`` on the loss axis alone
+   (the pre-r6 rule).
 
-Run: python scripts/parity_dynamics.py [--steps 400] [--out runs/parity_dynamics.json]
-     python scripts/parity_dynamics.py --mode null   # chaos-floor yardstick
+Run: python scripts/parity_dynamics.py --mode null   # chaos-floor yardstick
+     python scripts/parity_dynamics.py [--steps 400] [--null runs/parity_dynamics_null.json]
 """
 
 import argparse
@@ -41,6 +46,33 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from parity_trained import make_pair  # noqa: E402  (same synthetic generator)
+
+
+def floor_gate(loss_rel, epe_rel, null_summary=None, tolerance=0.02):
+    """The null-floor rule: pass ⇔ cross-framework deviation ≤ the measured
+    same-framework floor, on BOTH axes the null run measured.
+
+    ``null_summary`` is ``--mode null``'s JSON (``last_window_loss_rel`` +
+    ``final_epe.rel_dev``). Returns ``{"pass", "rule", "checks"}`` where
+    each check records the deviation, its floor, and the verdict; with no
+    null summary the gate is the fixed loss tolerance (the pre-r6 rule).
+    """
+    if null_summary:
+        checks = {}
+        floor_loss = null_summary.get("last_window_loss_rel")
+        if floor_loss is not None:
+            checks["loss"] = {"deviation": loss_rel, "floor": floor_loss,
+                              "ok": bool(loss_rel <= floor_loss)}
+        floor_epe = (null_summary.get("final_epe") or {}).get("rel_dev")
+        if floor_epe is not None and epe_rel is not None:
+            checks["epe"] = {"deviation": epe_rel, "floor": floor_epe,
+                             "ok": bool(epe_rel <= floor_epe)}
+        if checks:
+            return {"pass": all(c["ok"] for c in checks.values()),
+                    "rule": "null_floor", "checks": checks}
+    return {"pass": bool(loss_rel <= tolerance), "rule": "tolerance",
+            "checks": {"loss": {"deviation": loss_rel, "floor": tolerance,
+                                "ok": bool(loss_rel <= tolerance)}}}
 
 
 def main():
@@ -65,6 +97,10 @@ def main():
                         "trainings of THE SAME framework can be expected "
                         "to land, the yardstick for the 'both' deviations")
     p.add_argument("--perturb", type=float, default=1e-6)
+    p.add_argument("--null", default="runs/parity_dynamics_null.json",
+                   help="the --mode null run's JSON (the measured "
+                        "same-framework floor the gate compares against; "
+                        "missing -> fixed --tolerance fallback)")
     args = p.parse_args()
     if args.mode == "null" and args.out == p.get_default("out"):
         # never clobber the cross-framework artifact with the null summary
@@ -259,6 +295,17 @@ def main():
     t_epe, j_epe = float(np.mean(t_epes)), float(np.mean(j_epes))
     epe_rel = abs(j_epe - t_epe) / max(t_epe, 1e-9)
     last_rel = windows[-1]["rel_dev"]
+    # The GATE is the null-floor rule (floor_gate): cross-framework
+    # deviation passes iff it is within what the SAME framework deviates
+    # from a 1e-6-perturbed init on the same stream (--mode null's JSON) —
+    # both the last-window loss axis and the chaos-dominated final-EPE
+    # axis. The fixed --tolerance is only the fallback when no null run
+    # has been measured.
+    null_summary = None
+    if args.null and os.path.exists(args.null):
+        with open(args.null) as fh:
+            null_summary = json.load(fh)
+    gate = floor_gate(last_rel, epe_rel, null_summary, args.tolerance)
     summary = {
         "steps": args.steps, "batch": b, "train_size": [th, tw],
         "train_iters": iters, "windows": windows,
@@ -268,22 +315,21 @@ def main():
                  "pairs": args.eval_pairs},
         "torch_losses": [round(x, 5) for x in t_losses],
         "jax_losses": [round(x, 5) for x in j_losses],
-        # The GATE is the last-window loss deviation: that is the training-
-        # dynamics criterion. Final EPE over a handful of pairs is dominated
-        # by chaotic trajectory divergence — judge it against the measured
-        # same-framework floor from --mode null (torch-vs-torch with a 1e-6
-        # init perturbation deviates 8.0% EPE / 3.4% loss at 300 steps,
-        # runs/parity_dynamics_null.json), not against a fixed tolerance.
-        "pass": bool(last_rel <= args.tolerance),
+        "gate": gate,
+        "null_input": args.null if null_summary else None,
+        "pass": gate["pass"],
     }
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
     with open(args.out, "w") as fh:
         json.dump(summary, fh, indent=1)
+    floors = "; ".join(
+        f"{ax} {100 * c['deviation']:.2f}% vs floor {100 * c['floor']:.2f}%"
+        for ax, c in gate["checks"].items())
     print(f"\nfinal EPE: torch {t_epe:.4f} jax {j_epe:.4f} "
           f"rel {100*epe_rel:.2f}%  last-window loss rel "
           f"{100*last_rel:.2f}%  -> "
           f"{'PASS' if summary['pass'] else 'FAIL'} "
-          f"(tol {100*args.tolerance:.0f}%)", flush=True)
+          f"({gate['rule']}: {floors})", flush=True)
     return 0 if summary["pass"] else 1
 
 

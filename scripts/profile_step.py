@@ -73,6 +73,9 @@ def main():
                    help="the r4-measured best schedule: one-shot post-scan "
                         "upsample + saved loss tail + unfolded saves "
                         "(bench.py banker)")
+    p.add_argument("--run_dir", default=None,
+                   help="also emit xla_memory/xla_cost introspection "
+                        "events to <run_dir>/events.jsonl")
     args = p.parse_args()
 
     remat_enc = {"False": False, "True": True}.get(
@@ -100,9 +103,36 @@ def main():
                                     jnp.float32) * 50,
         "valid": jnp.ones((args.batch, args.h, args.w), jnp.float32),
     }
-    step = jax.jit(make_train_step(model, tx, args.iters,
-                                   fused_loss=not args.stacked),
-                   donate_argnums=(0,))
+    step_jit = jax.jit(make_train_step(model, tx, args.iters,
+                                       fused_loss=not args.stacked),
+                       donate_argnums=(0,))
+    # AOT compile (same executable + cache key as the first jitted call) so
+    # the profile carries the executable's memory/cost analyses alongside
+    # the trace — what the step NEEDS, next to where its time GOES.
+    from raft_stereo_tpu.obs.xla import introspect_compiled
+    step = step_jit.lower(state, batch).compile()
+    tel = None
+    if args.run_dir:
+        from raft_stereo_tpu.obs import Telemetry
+        tel = Telemetry(args.run_dir, stall_deadline_s=None)
+        tel.run_start(config=vars(args))
+    analysis = introspect_compiled(step, tel, source="profile_step",
+                                   extra={"batch": args.batch})
+    mem, cost = analysis["memory"], analysis["cost"]
+    if mem:
+        gib = 1024 ** 3
+        head = (f" (headroom {mem['headroom_bytes'] / gib:.2f} of "
+                f"{mem['capacity_bytes'] / gib:.1f} GiB)"
+                if "headroom_bytes" in mem else "")
+        print(f"xla memory: peak {mem['peak_bytes'] / gib:.2f} GiB{head} — "
+              f"args {mem.get('argument_bytes', 0) / gib:.2f}, "
+              f"temps {mem.get('temp_bytes', 0) / gib:.2f}, "
+              f"outputs {mem.get('output_bytes', 0) / gib:.2f} GiB")
+    if cost:
+        print(f"xla cost: {cost['flops']:.3g} flops, "
+              f"{cost.get('bytes_accessed', 0):.3g} bytes accessed"
+              + (f", {cost['flops_per_byte']} flops/byte"
+                 if "flops_per_byte" in cost else ""))
     state, m = step(state, batch)
     float(m["loss"])
     state, m = step(state, batch)
@@ -170,6 +200,9 @@ def main():
         for name, dur in t.most_common(args.top):
             print(f"  {dur / 1e3 / n:9.2f} ms x{c[name] // n:<4d} "
                   f"{name[:40]:40s} {meta[name][:70]}")
+    if tel is not None:
+        tel.emit("run_end", steps=args.steps, ok=True)
+        tel.close()
 
 
 if __name__ == "__main__":

@@ -19,13 +19,20 @@ rehearsal:
 * **events** — schema lint (scripts/check_events.py semantics) over the
   artifact logs a round leaves behind, so a drifted record fails here, not
   in the next round's summarizer.
+* **compare** — the run-regression gate (obs/compare.py): diff this chain's
+  bench telemetry (``runs/bench/current``, written by the bench leg — the
+  bench.py parent rotates the prior chain's log to ``runs/bench/previous``)
+  against the previous round's banked run, so an r5-style throughput wobble
+  or memory/compile-time regression fails the rehearsal instead of waiting
+  for a reviewer to notice. Skipped (ok, with a note) while no baseline
+  exists yet.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
 the rehearsal can gate a round's end ritual.
 
-Run: python scripts/rehearse_round.py [--legs bench multichip events]
-     [--bench-budget S] [--multichip-budget S]
+Run: python scripts/rehearse_round.py [--legs bench multichip events compare]
+     [--bench-budget S] [--multichip-budget S] [--baseline RUN_DIR]
 """
 
 import argparse
@@ -116,16 +123,39 @@ def check_event_artifacts(paths):
     return existing, errors
 
 
+def compare_leg(baseline, candidate, timeout_s=300.0):
+    """The regression-gate leg; skip-ok while either run dir is absent."""
+    missing = [d for d in (baseline, candidate)
+               if not os.path.exists(os.path.join(d, "events.jsonl"))]
+    if missing:
+        return {"leg": "compare", "ok": True, "skipped": True,
+                "error": None, "baseline": baseline, "candidate": candidate,
+                "note": f"no events.jsonl under {missing} — gate skipped"}
+    rec = run_leg("compare",
+                  [sys.executable, "-m", "raft_stereo_tpu.cli", "compare",
+                   baseline, candidate],
+                  timeout_s)
+    rec.update(baseline=baseline, candidate=candidate)
+    return rec
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Rehearse the driver's end-of-round commands under the "
                     "driver's budgets (see module doc)")
     p.add_argument("--legs", nargs="+", default=["bench", "multichip",
-                                                 "events"],
-                   choices=["bench", "multichip", "events"])
+                                                 "events", "compare"],
+                   choices=["bench", "multichip", "events", "compare"])
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
+    p.add_argument("--baseline",
+                   default=os.path.join(REPO, "runs", "bench", "previous"),
+                   help="baseline run dir for the compare gate (default: "
+                        "the previous bench chain's rotated telemetry)")
+    p.add_argument("--candidate",
+                   default=os.path.join(REPO, "runs", "bench", "current"),
+                   help="candidate run dir for the compare gate")
     args = p.parse_args(argv)
 
     records = []
@@ -147,6 +177,8 @@ def main(argv=None):
         records.append({"leg": "events", "ok": not errors,
                         "checked": checked, "error": "; ".join(errors[:5])
                         or None})
+    if "compare" in args.legs:
+        records.append(compare_leg(args.baseline, args.candidate))
 
     ok = True
     for rec in records:

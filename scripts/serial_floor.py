@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Decompose the refinement scan's serial floor per GRU iteration.
+
+PERF.md's ceiling argument rests on a ~450 ms batch-independent serial floor
+(the `lax.scan` over GRU refinement iterations — RAFT's iterative loop,
+arXiv:2003.12039 — forward and backward); VERDICT r5 #6 notes it has never
+been decomposed per-iteration. This script splits it with the chunked/
+unrolled timing mode (utils/profiling.py):
+
+* time the SAME graph at several iteration counts — the fit's slope is the
+  cost of one more GRU iteration, the intercept the per-call fixed work
+  (encoders + volume build + upsample/loss tail + host dispatch);
+* time the sweep again fully UNROLLED (``scan_unroll = iters``: XLA fuses
+  across iteration boundaries, no loop carry) — the rolled-minus-unrolled
+  slope isolates the loop/layout overhead each iteration pays for living
+  inside the ``while`` from its actual GRU/lookup compute;
+* record the per-iteration mean |delta disparity| (the model's in-graph
+  ``iter_metrics`` aux output) — how much each iteration still MOVES the
+  field, i.e. whether the serial floor is buying convergence.
+
+Every configuration is AOT-compiled (``lower().compile()``) and its
+xla_memory/xla_cost introspection (obs/xla.py) lands on the run's
+events.jsonl next to the timing JSON.
+
+Run: python scripts/serial_floor.py --run_dir runs/serial_floor \\
+         [--mode train|infer] [--iters 2 4 8 12] [--unroll-iters 2 4 8]
+     (defaults are CPU-sized; on the TPU host use --batch 8 --h 320 --w 720
+      --iters 2 6 12 22 for the flagship recipe's floor)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig  # noqa: E402
+from raft_stereo_tpu.models import init_model  # noqa: E402
+from raft_stereo_tpu.obs import Telemetry  # noqa: E402
+from raft_stereo_tpu.obs.xla import introspect_compiled  # noqa: E402
+from raft_stereo_tpu.utils.profiling import (  # noqa: E402
+    decompose_serial_floor, time_compiled)
+
+
+def build_fn(args, model, variables, state_and_tx, iters, mode):
+    """A jitted callable of no per-call setup: (args) -> outputs, plus the
+    (state/batch) operands it closes over, ready for lower/compile."""
+    b, h, w = args.batch, args.h, args.w
+    rng = np.random.default_rng(0)
+    if mode == "train":
+        from raft_stereo_tpu.training.state import make_train_step
+        state, tx = state_and_tx
+        batch = {
+            "image1": jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)),
+                                  jnp.float32),
+            "image2": jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)),
+                                  jnp.float32),
+            "flow": jnp.asarray(rng.uniform(-16, 0, (b, h, w, 1)),
+                                jnp.float32),
+            "valid": jnp.ones((b, h, w), jnp.float32),
+        }
+        step = jax.jit(make_train_step(model, tx, iters, fused_loss=True))
+        return step, (state, batch)
+    im1 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+    im2 = jnp.asarray(rng.uniform(0, 255, (b, h, w, 3)), jnp.float32)
+    fn = jax.jit(lambda a, c: model.apply(variables, a, c, iters=iters,
+                                          test_mode=True))
+    return fn, (im1, im2)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=["train", "infer"], default="infer",
+                   help="decompose the training step's scans (fwd+bwd) or "
+                        "the inference scan")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--h", type=int, default=96)
+    p.add_argument("--w", type=int, default=160)
+    p.add_argument("--iters", type=int, nargs="+", default=[2, 4, 8, 12],
+                   help="rolled-scan iteration counts to sweep")
+    p.add_argument("--unroll-iters", type=int, nargs="+", default=None,
+                   help="iteration counts for the fully-unrolled contrast "
+                        "sweep (default: same as --iters; pass 0 to skip)")
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--mixed_precision", action="store_true")
+    p.add_argument("--run_dir", default="runs/serial_floor")
+    args = p.parse_args()
+
+    platform = jax.devices()[0].platform
+    tel = Telemetry(args.run_dir, stall_deadline_s=None)
+    tel.run_start(config={**vars(args), "platform": platform})
+
+    def setup(unroll):
+        cfg = RAFTStereoConfig(mixed_precision=args.mixed_precision,
+                               scan_unroll=unroll)
+        model, variables = init_model(jax.random.PRNGKey(0), cfg,
+                                      (1, args.h, args.w, 3))
+        state_and_tx = None
+        if args.mode == "train":
+            from raft_stereo_tpu.training.optim import fetch_optimizer
+            from raft_stereo_tpu.training.state import TrainState
+            tx = fetch_optimizer(TrainConfig(
+                batch_size=args.batch, image_size=(args.h, args.w)))
+            state_and_tx = (TrainState.create(variables, tx), tx)
+        return cfg, model, variables, state_and_tx
+
+    def sweep(iters_list, unrolled):
+        times = {}
+        cfg_cache = {}
+        for it in iters_list:
+            unroll = it if unrolled else 1
+            if unroll not in cfg_cache:
+                cfg_cache[unroll] = setup(unroll)
+            cfg, model, variables, st = cfg_cache[unroll]
+            fn, operands = build_fn(args, model, variables, st, it,
+                                    args.mode)
+            t0 = time.perf_counter()
+            compiled = fn.lower(*operands).compile()
+            compile_s = time.perf_counter() - t0
+            tag = (f"serial_floor_{args.mode}_it{it}"
+                   + ("_unrolled" if unrolled else ""))
+            tel.emit("compile", duration_s=round(compile_s, 3), source=tag)
+            introspect_compiled(compiled, tel, source=tag,
+                                extra={"iters": it,
+                                       "unrolled": bool(unrolled)})
+            times[it] = time_compiled(compiled, operands,
+                                      repeats=args.repeats)
+            print(f"{tag}: {times[it] * 1e3:.1f} ms "
+                  f"(compile {compile_s:.1f} s)", flush=True)
+        return times
+
+    rolled = sweep(args.iters, unrolled=False)
+    unroll_iters = (args.iters if args.unroll_iters is None
+                    else [i for i in args.unroll_iters if i > 0])
+    unrolled = sweep(unroll_iters, unrolled=True) if unroll_iters else None
+
+    decomp = decompose_serial_floor(rolled, unrolled)
+
+    # convergence axis: what each iteration still moves the disparity field
+    # (in-graph aux, iter_metrics) — inference scan only
+    delta_norms = None
+    if args.mode == "infer":
+        cfg, model, variables, _ = setup(1)
+        it = max(args.iters)
+        rng = np.random.default_rng(0)
+        im1 = jnp.asarray(rng.uniform(0, 255, (args.batch, args.h, args.w, 3)),
+                          jnp.float32)
+        im2 = jnp.asarray(rng.uniform(0, 255, (args.batch, args.h, args.w, 3)),
+                          jnp.float32)
+        _, _, norms = jax.jit(
+            lambda a, c: model.apply(variables, a, c, iters=it,
+                                     test_mode=True, iter_metrics=True)
+        )(im1, im2)
+        delta_norms = [round(float(x), 5) for x in np.asarray(norms)]
+
+    summary = {
+        "mode": args.mode, "platform": platform,
+        "batch": args.batch, "image_size": [args.h, args.w],
+        "decomposition": decomp,
+        "delta_disparity_norms": delta_norms,
+    }
+    out_path = os.path.join(args.run_dir, "serial_floor.json")
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=1)
+    tel.emit("run_end", steps=len(rolled), ok=True)
+    tel.close()
+
+    ms = lambda s: f"{s * 1e3:.2f} ms"  # noqa: E731
+    print(f"\nserial-floor decomposition ({args.mode}, {platform}, "
+          f"b{args.batch} {args.h}x{args.w}):")
+    print(f"  fixed per call:        {ms(decomp['fixed_s'])}")
+    print(f"  per iteration (total): {ms(decomp['per_iter_s'])}")
+    if "per_iter_compute_s" in decomp:
+        print(f"  per iteration compute: {ms(decomp['per_iter_compute_s'])}")
+        print(f"  per iteration loop/layout overhead: "
+              f"{ms(decomp['per_iter_loop_overhead_s'])}")
+    if delta_norms:
+        print(f"  delta-disparity norms: {delta_norms}")
+    print(f"artifact: {out_path} (+ events.jsonl)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,374 @@
+"""Device-side observatory: compiled-artifact introspection (obs/xla.py),
+the schema-v2 xla events, the run-regression gate (obs/compare.py), the
+buffer-assignment parser, the serial-floor decomposition helpers, and the
+parity null-floor gate."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from raft_stereo_tpu.obs import (SCHEMA_VERSION, Telemetry, append_json_log,
+                                 make_record, read_events, validate_events,
+                                 validate_record)
+from raft_stereo_tpu.obs.compare import compare_runs
+from raft_stereo_tpu.obs.compare import main as compare_main
+from raft_stereo_tpu.obs.xla import (compact_xla_summary, cost_analysis_dict,
+                                     introspect_compiled,
+                                     memory_analysis_dict,
+                                     parse_buffer_assignment,
+                                     summarize_buffer_assignment)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def tiny_compiled():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, y):
+        return jnp.dot(x, y) + x.sum()
+
+    x = jnp.ones((64, 64))
+    return jax.jit(f).lower(x, x).compile()
+
+
+# --- extraction -------------------------------------------------------------
+
+def test_memory_analysis_extraction(tiny_compiled):
+    mem = memory_analysis_dict(tiny_compiled)
+    assert mem is not None
+    # two (64,64) fp32 args in, one out
+    assert mem["argument_bytes"] == 2 * 64 * 64 * 4
+    assert mem["output_bytes"] == 64 * 64 * 4
+    assert mem["temp_bytes"] > 0
+    assert mem["peak_bytes"] == (mem["argument_bytes"] + mem["output_bytes"]
+                                 + mem["temp_bytes"]
+                                 + mem.get("generated_code_bytes", 0)
+                                 - mem.get("alias_bytes", 0))
+
+
+def test_cost_analysis_extraction(tiny_compiled):
+    cost = cost_analysis_dict(tiny_compiled)
+    assert cost is not None
+    # 64x64x64 matmul alone is 2*64^3 = 524288 flops
+    assert cost["flops"] >= 2 * 64 ** 3
+    assert cost["bytes_accessed"] > 0
+    assert cost["flops_per_byte"] == pytest.approx(
+        cost["flops"] / cost["bytes_accessed"], rel=1e-3)
+
+
+def test_introspection_never_raises_on_junk():
+    class Broken:
+        def memory_analysis(self):
+            raise RuntimeError("backend moved")
+
+        def cost_analysis(self):
+            raise RuntimeError("backend moved")
+
+    assert memory_analysis_dict(Broken()) is None
+    assert cost_analysis_dict(Broken()) is None
+    assert introspect_compiled(Broken()) == {"memory": None, "cost": None}
+    assert compact_xla_summary({"memory": None, "cost": None}) is None
+
+
+# --- event emission + schema ------------------------------------------------
+
+def test_introspect_emits_schema_clean_events(tmp_path, tiny_compiled):
+    run = tmp_path / "run"
+    tel = Telemetry(str(run))
+    tel.run_start()
+    analysis = introspect_compiled(tiny_compiled, tel, source="unit",
+                                   extra={"batch": 3})
+    tel.emit("run_end", steps=0, ok=True)
+    tel.close()
+    assert analysis["memory"] is not None and analysis["cost"] is not None
+
+    events = read_events(str(run / "events.jsonl"))
+    assert validate_events(events) == []
+    mem = next(e for e in events if e["event"] == "xla_memory")
+    cost = next(e for e in events if e["event"] == "xla_cost")
+    assert mem["source"] == "unit" and mem["batch"] == 3
+    assert mem["peak_bytes"] == analysis["memory"]["peak_bytes"]
+    assert cost["flops"] == analysis["cost"]["flops"]
+
+    # the scripts/ lint accepts the new events
+    sys.path.insert(0, str(REPO / "scripts"))
+    import check_events
+    assert check_events.main([str(run)]) == 0
+
+
+def test_schema_v1_back_compat():
+    # a v1 record of a v1 event still lints clean after the v2 bump ...
+    v1 = make_record("step", step=1, data_wait_s=0.0, dispatch_s=0.1,
+                     fetch_s=0.0)
+    v1["schema"] = 1
+    assert validate_record(v1) == []
+    # ... but a v2-only event may not claim v1, and unknown versions fail
+    bad = make_record("xla_memory", source="x", peak_bytes=1)
+    bad["schema"] = 1
+    assert any("introduced in schema" in e for e in validate_record(bad))
+    future = dict(v1, schema=SCHEMA_VERSION + 1)
+    assert validate_record(future)
+    # current-version xla events with required fields are clean
+    assert validate_record(
+        make_record("xla_memory", source="x", peak_bytes=1)) == []
+    assert validate_record(make_record("xla_cost", source="x",
+                                       flops=1.0)) == []
+    assert validate_record(make_record("xla_cost", source="x"))  # no flops
+
+
+# --- summarizer -------------------------------------------------------------
+
+def test_summarizer_reports_headroom_and_flops_per_byte(tmp_path):
+    from raft_stereo_tpu.obs import format_summary, summarize_run
+    run = tmp_path / "run"
+    path = str(run / "events.jsonl")
+    append_json_log(path, make_record("run_start", t=0.0, run="x"),
+                    stream=None)
+    gib = 1024 ** 3
+    append_json_log(path, make_record(
+        "xla_memory", t=1.0, source="bench_b8", peak_bytes=12 * gib,
+        temp_bytes=9 * gib, argument_bytes=2 * gib,
+        capacity_bytes=16 * gib, headroom_bytes=4 * gib), stream=None)
+    append_json_log(path, make_record(
+        "xla_cost", t=1.0, source="bench_b8", flops=3.2e12,
+        bytes_accessed=4.0e11, flops_per_byte=8.0), stream=None)
+    report = summarize_run(str(run))
+    xl = report["events"]["xla"]
+    assert xl["peak_bytes"] == 12 * gib
+    assert xl["headroom_bytes"] == 4 * gib
+    assert xl["flops_per_byte"] == 8.0
+    text = format_summary(report)
+    assert "headroom 4.00 GiB" in text
+    assert "8.0 flops/byte" in text
+    assert "peak 12.00 GiB of 16.0 GiB" in text
+
+
+# --- buffer-assignment parsing ----------------------------------------------
+
+_BA_TEXT = """\
+BufferAssignment:
+allocation 0: size 16384, parameter 0, shape |f32[64,64]| at ShapeIndex {}, output shape is |f32[64,64]|, maybe-live-out:
+ value: <7 Arg_0.1 @0> (size=16384,offset=0): f32[64,64]{1,0}
+ value: <13 broadcast_add_fusion @0> (size=16384,offset=0): f32[64,64]{1,0}
+allocation 1: size 16384, parameter 1, shape |f32[64,64]| at ShapeIndex {}:
+ value: <8 Arg_1.2 @0> (size=16384,offset=0): f32[64,64]{1,0}
+allocation 2: size 4, constant:
+ value: <10 constant.3 @0> (size=4,offset=0): f32[]
+allocation 6: size 16452, preallocated-temp:
+ value: <9 dot.4 @0> (size=16384,offset=0): f32[64,64]{1,0}
+ value: <11 reduce-window @0> (size=16,offset=16384): f32[2,2]{1,0}
+ value: <12 reduce.9 @0> (size=4,offset=16448): f32[]
+
+Total bytes used: 49236 (48.1KiB)
+
+Used values:
+<0 Arg_0.6 @0>
+ value: <999 should-not-be-parsed @0> (size=999,offset=0): f32[9]
+"""
+
+
+def test_parse_buffer_assignment_names_buffers():
+    parsed = parse_buffer_assignment(_BA_TEXT)
+    assert parsed["total_bytes"] == 49236
+    assert [a["index"] for a in parsed["allocations"]] == [0, 1, 2, 6]
+    kinds = {a["index"]: a["kind"] for a in parsed["allocations"]}
+    assert kinds[0] == "parameter" and kinds[6] == "temp"
+    assert parsed["allocations"][0]["maybe_live_out"] is True
+    # the "Used values" tail is not parsed as allocations
+    assert all(v["size"] != 999
+               for a in parsed["allocations"] for v in a["values"])
+
+    summary = summarize_buffer_assignment(_BA_TEXT, top=3)
+    assert summary["temp_bytes"] == 16452
+    dom = summary["dominant_temp"]
+    assert dom["allocation"] == 6
+    assert dom["top_values"][0]["instruction"] == "dot.4"
+    assert dom["top_values"][0]["shape"].startswith("f32[64,64]")
+
+
+# --- the regression gate ----------------------------------------------------
+
+def _write_run_events(run_dir, throughput=9.6, dispatch=0.8, peak=9e9,
+                      compile_s=120.0):
+    path = str(Path(run_dir) / "events.jsonl")
+    append_json_log(path, make_record("run_start", t=0.0, run="r"),
+                    stream=None)
+    append_json_log(path, make_record("compile", t=1.0,
+                                      duration_s=compile_s, source="aot"),
+                    stream=None)
+    append_json_log(path, make_record(
+        "xla_memory", t=1.0, source="bench", peak_bytes=peak), stream=None)
+    for i in range(6):
+        append_json_log(path, make_record(
+            "step", t=2.0 + i, step=i + 1, data_wait_s=0.0,
+            dispatch_s=dispatch, fetch_s=0.01, batch_size=8), stream=None)
+    append_json_log(path, make_record(
+        "throughput", t=9.0, pairs_per_sec=throughput, steps=6),
+        stream=None)
+    append_json_log(path, make_record("run_end", t=9.5, steps=6, ok=True),
+                    stream=None)
+
+
+def test_compare_identical_runs_pass(tmp_path, capsys):
+    a, b = tmp_path / "a", tmp_path / "b"
+    _write_run_events(a)
+    _write_run_events(b)
+    assert compare_main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "no metric moved past its threshold" in out
+
+
+def test_compare_flags_throughput_regression(tmp_path, capsys):
+    a, b = tmp_path / "a", tmp_path / "b"
+    _write_run_events(a, throughput=9.64)
+    _write_run_events(b, throughput=9.0)   # -6.6% > the 3% gate
+    rc = compare_main([str(a), str(b), "--json",
+                       str(tmp_path / "cmp.json")])
+    assert rc == 1
+    assert "throughput_pairs_per_sec" in capsys.readouterr().out
+    report = json.loads((tmp_path / "cmp.json").read_text())
+    assert report["regressions"] == ["throughput_pairs_per_sec"]
+    # the r5 wobble (9.639 -> 9.577, -0.6%) stays inside the noise gate
+    assert compare_runs(str(a), str(a))["ok"]
+    _write_run_events(tmp_path / "c", throughput=9.577)
+    _write_run_events(tmp_path / "d", throughput=9.639)
+    assert compare_runs(str(tmp_path / "d"), str(tmp_path / "c"))["ok"]
+
+
+def test_compare_flags_memory_and_compile_regressions(tmp_path):
+    a = tmp_path / "a"
+    _write_run_events(a, peak=9e9, compile_s=100.0)
+    worse_mem = tmp_path / "m"
+    _write_run_events(worse_mem, peak=11e9)          # +22% > 5%
+    report = compare_runs(str(a), str(worse_mem))
+    assert "peak_memory_bytes" in report["regressions"]
+    worse_compile = tmp_path / "c"
+    _write_run_events(worse_compile, compile_s=220.0)  # +120% > 50%
+    report = compare_runs(str(a), str(worse_compile))
+    assert "compile_total_s" in report["regressions"]
+    # improvement in the good direction never regresses
+    better = tmp_path / "g"
+    _write_run_events(better, throughput=12.0, peak=5e9, compile_s=10.0)
+    assert compare_runs(str(a), str(better))["ok"]
+
+
+def test_compare_skips_one_sided_metrics_and_rejects_empty(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    _write_run_events(a)
+    # candidate without throughput/memory events: those skip, phases compare
+    path = str(b / "events.jsonl")
+    append_json_log(path, make_record("run_start", t=0.0, run="r"),
+                    stream=None)
+    for i in range(3):
+        append_json_log(path, make_record(
+            "step", t=1.0 + i, step=i + 1, data_wait_s=0.0, dispatch_s=0.8,
+            fetch_s=0.01), stream=None)
+    report = compare_runs(str(a), str(b))
+    assert report["ok"]
+    assert "throughput_pairs_per_sec" in report["skipped"]
+    assert "peak_memory_bytes" in report["skipped"]
+    # no events at all on either side is an ERROR (exit 2), not a pass
+    assert compare_main([str(a), str(tmp_path / "missing")]) == 2
+    assert compare_main([str(tmp_path / "missing"), str(a)]) == 2
+
+
+def test_bench_run_dir_rotation(tmp_path, monkeypatch):
+    """The chain's telemetry rotation: current -> previous, so the compare
+    gate always has last chain's log as its baseline."""
+    import bench
+    monkeypatch.delenv("BENCH_RUN_DIR", raising=False)
+    monkeypatch.setenv("BENCH_RUN_ROOT", str(tmp_path))
+    current = tmp_path / "current"
+    # first chain: nothing to rotate, env points children at current
+    assert bench._rotate_bench_run_dir() == str(current)
+    current.mkdir(parents=True)
+    (current / "events.jsonl").write_text('{"a": 1}\n')
+    # second chain: the prior log becomes the baseline
+    monkeypatch.delenv("BENCH_RUN_DIR", raising=False)
+    assert bench._rotate_bench_run_dir() == str(current)
+    assert (tmp_path / "previous" / "events.jsonl").exists()
+    assert not current.exists()
+    # an externally-set BENCH_RUN_DIR is respected untouched
+    monkeypatch.setenv("BENCH_RUN_DIR", "/elsewhere")
+    assert bench._rotate_bench_run_dir() == "/elsewhere"
+
+
+def test_rehearsal_compare_leg(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    import rehearse_round
+    a, b = tmp_path / "prev", tmp_path / "cur"
+    _write_run_events(a)
+    _write_run_events(b)
+    rec = rehearse_round.compare_leg(str(a), str(b))
+    assert rec["ok"] and not rec.get("skipped")
+    _write_run_events(tmp_path / "bad", throughput=5.0)
+    rec = rehearse_round.compare_leg(str(a), str(tmp_path / "bad"))
+    assert not rec["ok"]
+    # missing baseline skips green (a first round has nothing to diff)
+    rec = rehearse_round.compare_leg(str(tmp_path / "nope"), str(b))
+    assert rec["ok"] and rec["skipped"]
+
+
+# --- serial-floor decomposition helpers -------------------------------------
+
+def test_decompose_serial_floor_recovers_linear_model():
+    from raft_stereo_tpu.utils.profiling import (decompose_serial_floor,
+                                                 fit_linear)
+    # ground truth: fixed 0.45 s, 0.02 s/iter rolled, 0.015 s/iter unrolled
+    rolled = {i: 0.45 + 0.02 * i for i in (2, 4, 8, 16)}
+    unrolled = {i: 0.44 + 0.015 * i for i in (2, 4, 8)}
+    d = decompose_serial_floor(rolled, unrolled)
+    assert d["fixed_s"] == pytest.approx(0.45, abs=1e-6)
+    assert d["per_iter_s"] == pytest.approx(0.02, abs=1e-6)
+    assert d["per_iter_compute_s"] == pytest.approx(0.015, abs=1e-6)
+    assert d["per_iter_loop_overhead_s"] == pytest.approx(0.005, abs=1e-6)
+    with pytest.raises(ValueError):
+        fit_linear([3.0], [1.0])
+
+
+def test_model_iter_metrics_aux_outputs():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import init_model
+
+    cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 64, 96, 3))
+    x = jnp.linspace(0, 255, 1 * 64 * 96 * 3).reshape(1, 64, 96, 3)
+    lo, up = model.apply(variables, x, x, iters=3, test_mode=True)
+    lo2, up2, norms = model.apply(variables, x, x, iters=3, test_mode=True,
+                                  iter_metrics=True)
+    assert norms.shape == (3,)
+    assert np.all(np.isfinite(np.asarray(norms)))
+    # the aux output does not perturb the prediction
+    assert np.allclose(np.asarray(up), np.asarray(up2))
+    # train mode has no inference scan to instrument — loud, not silent
+    with pytest.raises(ValueError, match="test_mode"):
+        model.apply(variables, x, x, iters=2, iter_metrics=True)
+
+
+# --- parity null-floor gate -------------------------------------------------
+
+def test_parity_floor_gate_rules():
+    sys.path.insert(0, str(REPO / "scripts"))
+    from parity_dynamics import floor_gate
+
+    null = {"last_window_loss_rel": 0.0335,
+            "final_epe": {"rel_dev": 0.0801}}
+    # the r5 measured values: 1.3% loss / 7.65% EPE vs 3.35% / 8.01% floor
+    g = floor_gate(0.01296, 0.0765, null)
+    assert g["pass"] and g["rule"] == "null_floor"
+    assert g["checks"]["loss"]["ok"] and g["checks"]["epe"]["ok"]
+    # either axis exceeding its floor fails
+    assert not floor_gate(0.05, 0.0765, null)["pass"]
+    assert not floor_gate(0.01296, 0.09, null)["pass"]
+    # no null run -> fixed-tolerance fallback on the loss axis
+    g = floor_gate(0.019, None, None, tolerance=0.02)
+    assert g["pass"] and g["rule"] == "tolerance"
+    assert not floor_gate(0.021, None, None, tolerance=0.02)["pass"]

@@ -105,6 +105,12 @@ def test_train_smoke_emits_telemetry(tmp_path):
     # the phase split is real: data decode waited, the device dispatched
     assert all(s["data_wait_s"] > 0 and s["dispatch_s"] > 0 for s in steps)
     assert any(e["event"] == "compile" for e in events)
+    # the first step was AOT-compiled and introspected (obs/xla.py): the
+    # executable's memory/cost analyses are on the run record
+    xm = next(e for e in events if e["event"] == "xla_memory")
+    assert xm["source"] == "train_step" and xm["peak_bytes"] > 0
+    xc = next(e for e in events if e["event"] == "xla_cost")
+    assert xc["flops"] > 0
     ck = next(e for e in events if e["event"] == "checkpoint")
     assert ck["step"] == 2 and os.path.isdir(ck["path"])
     end = events[-1]
