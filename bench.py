@@ -55,7 +55,8 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
               upsample_tile_budget=None, remat_loss_tail=True,
               fold_enc_saves=None, scan_unroll=1,
               refinement_save_policy=None, corr_implementation="reg",
-              corr_storage_dtype="bfloat16", compile_only=False):
+              corr_storage_dtype="bfloat16", batched_scan_wgrad=None,
+              residual_dtype=None, compile_only=False):
     # Persistent compilation cache, shared across attempt subprocesses AND
     # driver runs: the tunneled remote-compile helper goes through long
     # degraded windows (r3: every big graph rejected; r4: wedged for hours);
@@ -90,7 +91,9 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
                            remat_loss_tail=remat_loss_tail,
                            fold_enc_saves=fold_enc_saves,
                            scan_unroll=scan_unroll,
-                           refinement_save_policy=refinement_save_policy)
+                           refinement_save_policy=refinement_save_policy,
+                           batched_scan_wgrad=batched_scan_wgrad,
+                           residual_dtype=residual_dtype)
     tcfg = TrainConfig(batch_size=batch, train_iters=train_iters,
                        num_steps=200000, image_size=(h, w))
 
@@ -165,6 +168,11 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
             "metric": metric, "value": value, "unit": unit,
             "platform": platform, "batch": batch,
             "train_iters": train_iters, "image_size": [h, w],
+            # The scan-backward A/B flag (PERF.md r8): which refinement
+            # backward produced this number — "batched_wgrad" (custom VJP,
+            # ops/scan_grad.py) or "autodiff" (the pinned-off control).
+            "scan_backward": ("batched_wgrad" if batched_scan_wgrad
+                              else "autodiff"),
         }
         if xla is not None:
             out["xla"] = xla
@@ -260,7 +268,14 @@ def _attempt_chain(on_tpu):
     """
     if not on_tpu:
         return [dict(kw=dict(batch=2, h=96, w=160, train_iters=4, steps=3),
-                     when="always", note=None)]
+                     when="always", note=None),
+                # The scan-backward A/B rides the reduced chain too so
+                # non-TPU rounds still leave both-paths artifacts in
+                # attempts.jsonl (numbers not comparable across platforms).
+                dict(kw=dict(batch=2, h=96, w=160, train_iters=4, steps=3,
+                             batched_scan_wgrad=True),
+                     when="always",
+                     note="scan custom-VJP A/B (batched weight grads)")]
     recipe = FLAGSHIP_RECIPE
     # The r4-measured winning schedule (9.42 pairs/s): one-shot post-scan
     # upsample (the lax.map chunking's serialization cost -0.12), SAVED
@@ -300,6 +315,22 @@ def _attempt_chain(on_tpu):
                      remat_encoders="blocks_hires", **best_sched,
                      **{**recipe, "steps": _BANKER_TIMED_STEPS}),
              when="below_par", note="hires-blocks banker, r4 best schedule"),
+        # Scan-backward A/B (PERF.md r8): the banker schedule with the
+        # custom-VJP refinement scan ON — batched weight gradients + bf16
+        # residual stacks (residual_dtype bounds the (input, cotangent)
+        # stacks that made this lever memory-infeasible in the r4
+        # analysis). `always`, so benchmark day banks whichever backward
+        # is faster: if this beats the banker it becomes the round's
+        # number, if it regresses the gate above already banked the
+        # autodiff control — either way both rows land in attempts.jsonl
+        # and the banked JSON line carries the scan_backward flag.
+        dict(kw=dict(batch=8, fused_loss=True,
+                     remat_encoders="blocks_hires",
+                     batched_scan_wgrad=True, residual_dtype="bfloat16",
+                     **best_sched, **recipe),
+             when="always",
+             note="scan custom-VJP A/B (batched weight grads, bf16 "
+                  "residual stacks); pinned-off control = banker"),
         # The full blocks-remat config: ~1.7 GB less residency than the
         # banker and proven over three rounds of sessions — the next stop
         # if the banker's extra saves stop fitting.

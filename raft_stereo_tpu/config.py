@@ -127,11 +127,41 @@ class RAFTStereoConfig:
     # the iteration body inside the while loop, amortizing per-iteration
     # dispatch overhead and letting XLA fuse across consecutive iterations
     # — at the cost of a proportionally larger graph. Semantically
-    # identical. Measured at SceneFlow b8 (r4): unroll=2 gave 9.23 vs 9.42
+    # identical. A LIVE knob (PERF.md's r2 "knob removed" note was stale —
+    # reconciled r8): the r4 inference A/B re-measured it on both presets
+    # and scripts/serial_floor.py's rolled-vs-unrolled decomposition depends
+    # on it. Measured at SceneFlow b8 (r4): unroll=2 gave 9.23 vs 9.42
     # pairs/s — the scan body's ops are large enough that dispatch
     # overhead is not the binding cost there; smaller/lower-batch shapes
     # may differ, hence the knob.
     scan_unroll: int = 1
+    # Ours: custom-VJP refinement scan with batched weight gradients
+    # (ops/scan_grad.py). True restructures the training backward: one
+    # reverse scan computes data gradients only, and each GRU gate conv's
+    # weight gradient is computed AFTER the scan as a single contraction
+    # over the (iters*B)-stacked (input, cotangent) pairs — one MXU-shaped
+    # wgrad conv instead of 22 small accumulating ones (~1.1 ms/iter,
+    # PERF.md roofline lever #2). The trade is residual memory: the stacks
+    # are multiple GB at SceneFlow b8 (the r4 analysis that deferred this
+    # lever), bounded by residual_dtype. None = auto, currently OFF: the
+    # memory/throughput trade is unmeasured-on-hardware and b8's headroom
+    # says it loses there; bench.py carries the ON attempt every round so
+    # benchmark day banks whichever path is faster (the A/B the r8 issue
+    # requires). Gradients are equivalence-pinned either way
+    # (tests/test_scan_grad.py).
+    batched_scan_wgrad: Optional[bool] = None
+    # Ours: storage dtype for refinement-backward residual stacks — the
+    # allocation class the r7 breakdown named dominant
+    # ([22,B,80,180,128..144]). On the custom-VJP path this narrows every
+    # stacked residual (saved carries, save-policy stacks, wgrad
+    # input/cotangent stacks) WITHOUT touching forward numerics; batched
+    # contractions still accumulate fp32. On the autodiff path it rounds
+    # the tagged gru_zr/gru_q/corr_feats saves through this dtype while a
+    # save policy is engaged (one rounding on the saved values — the
+    # documented-tolerance regime, tests/test_scan_grad.py). Also feeds the
+    # save-policy size estimate (refinement_save_policy_fits), so bf16
+    # residuals can re-admit the policy at shapes fp32 saves priced out.
+    residual_dtype: Optional[str] = None
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
@@ -167,6 +197,14 @@ class RAFTStereoConfig:
             raise ValueError(
                 f"unknown corr_storage_dtype {self.corr_storage_dtype!r}; "
                 "expected None, 'float32' or 'bfloat16'")
+        if self.residual_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                f"unknown residual_dtype {self.residual_dtype!r}; "
+                "expected None, 'float32' or 'bfloat16'")
+        if self.batched_scan_wgrad not in (None, True, False):
+            raise ValueError(
+                f"batched_scan_wgrad must be None (auto), True or False, "
+                f"got {self.batched_scan_wgrad!r}")
         if len(self.hidden_dims) != 3 or self.hidden_dims[0] != self.hidden_dims[2]:
             # The reference wires context conv i (sized hidden_dims[i]) into the
             # GRU at level i whose hidden size is hidden_dims[2-i]

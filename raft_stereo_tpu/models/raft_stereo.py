@@ -19,11 +19,9 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.ad_checkpoint import checkpoint_name
-
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
-from raft_stereo_tpu.nn.gru import BasicMultiUpdateBlock
+from raft_stereo_tpu.nn.gru import BasicMultiUpdateBlock, tag_residual
 from raft_stereo_tpu.nn.layers import Conv, ResidualBlock
 from raft_stereo_tpu.ops.corr import CorrState, corr_lookup, init_corr
 from raft_stereo_tpu.ops.geometry import (
@@ -63,7 +61,8 @@ def fold_enc_saves_auto(cfg, batch: int, height: int, width: int) -> bool:
 
 
 def refinement_save_policy_fits(cfg, iters: int, batch: int, h: int, w: int,
-                                dt, fused_lookup: bool = False) -> bool:
+                                dt, fused_lookup: bool = False,
+                                residual_dtype=None) -> bool:
     """Whether the selective save policy (save ``gru_zr``/``gru_q``/
     ``corr_feats`` across the refinement backward) engages, vs full remat.
 
@@ -72,7 +71,12 @@ def refinement_save_policy_fits(cfg, iters: int, batch: int, h: int, w: int,
     batch 4 yet 1085 vs 879 ms at batch 8 — HBM pressure inverts the trade.
     The estimate sums the tagged tensors at every GRU level per slow_fast
     pre-pass in the compute dtype's width; 1.5 GB covers the measured-good
-    batch-4 bf16 point (1.36 GB) while excluding unproven batch >= 6."""
+    batch-4 bf16 point (1.36 GB) while excluding unproven batch >= 6.
+
+    ``residual_dtype`` (config.residual_dtype): saves are stored at that
+    width when it is narrower than the compute dtype — bf16 residuals halve
+    the estimate for fp32-compute configs, admitting the policy at shapes
+    the fp32 saves priced out (the knob's whole point)."""
     per_px = 3.0 * cfg.hidden_dims[2] + cfg.corr_channels
     if cfg.n_gru_layers >= 2:
         per_px += 3.0 * cfg.hidden_dims[1] / 4
@@ -83,7 +87,8 @@ def refinement_save_policy_fits(cfg, iters: int, batch: int, h: int, w: int,
             per_px += 2 * 3.0 * cfg.hidden_dims[0] / 16
         if cfg.n_gru_layers >= 2:
             per_px += 3.0 * cfg.hidden_dims[1] / 4
-    bytes_per = 2 if dt == jnp.bfloat16 else 4
+    bytes_per = 2 if (dt == jnp.bfloat16
+                      or residual_dtype in ("bfloat16", jnp.bfloat16)) else 4
     saved_bytes = int(iters * batch * h * w * per_px * bytes_per)
     if fused_lookup:
         # no standalone corr tensor exists on the fused path; the kernel's
@@ -148,10 +153,16 @@ class RefinementStep(nn.Module):
     deferred: bool = False
     dtype: Optional[Dtype] = None
     fused_lookup: bool = False
+    # residual_dtype plumbing for the autodiff path's tagged saves, scoped
+    # per tag so only tensors a policy actually KEEPS get the cast-through:
+    # save_dtype covers corr_feats (kept by both the full and "corr"
+    # policies), gate_save_dtype the gru_zr/gru_q tags (full policy only).
+    save_dtype: Optional[Dtype] = None
+    gate_save_dtype: Optional[Dtype] = None
 
     @nn.compact
     def __call__(self, carry, corr_state: CorrState, inp_list, coords0,
-                 gt_and_mask, compute_mask: bool = True):
+                 gt_and_mask, compute_mask: bool = True, wgrad_tap=None):
         net, coords1 = carry[0], carry[1]
         coords1 = jax.lax.stop_gradient(coords1)
 
@@ -161,26 +172,38 @@ class RefinementStep(nn.Module):
             # lookup + convc1 run as one Pallas kernel inside the motion
             # encoder; no standalone corr tensor exists
             corr = None
+        elif wgrad_tap is not None:
+            # custom-VJP scan (ops/scan_grad.py): the tap owns save/replay
+            # of the corr lookup; checkpoint tags are inert on this path
+            corr = wgrad_tap.corr_site(corr_state, coords1, dt0)
         else:
             corr = corr_lookup(corr_state, coords1)
-            corr = checkpoint_name(corr.astype(dt0) if dt0 else corr,
-                                   "corr_feats")
+            corr = tag_residual(corr.astype(dt0) if dt0 else corr,
+                                "corr_feats", self.save_dtype)
 
         cfg = self.cfg
         dt = self.dtype
-        block = BasicMultiUpdateBlock(cfg, dtype=dt, name="update_block")
+        # Per-application tap prefixes: the slow_fast pre-iterations re-run
+        # GRU levels on the SAME params, so each block application needs its
+        # own residual stacks in the batched-weight-grad backward.
+        tp = (wgrad_tap.scoped if wgrad_tap is not None
+              else (lambda prefix: None))
+        block = BasicMultiUpdateBlock(cfg, dtype=dt,
+                                      save_dtype=self.gate_save_dtype,
+                                      name="update_block")
         if cfg.slow_fast_gru and cfg.n_gru_layers == 3:
             net = block(net, inp_list, iter32=True, iter16=False, iter08=False,
-                        update=False)
+                        update=False, wgrad_tap=tp("pre32"))
         if cfg.slow_fast_gru and cfg.n_gru_layers >= 2:
             net = block(net, inp_list, iter32=cfg.n_gru_layers == 3,
-                        iter16=True, iter08=False, update=False)
+                        iter16=True, iter08=False, update=False,
+                        wgrad_tap=tp("pre16"))
         net, mask, delta_flow = block(
             net, inp_list, corr, flow.astype(dt) if dt else flow,
             iter32=cfg.n_gru_layers == 3, iter16=cfg.n_gru_layers >= 2,
             corr_state=corr_state if self.fused_lookup else None,
             coords_x=coords1[..., 0] if self.fused_lookup else None,
-            compute_mask=compute_mask)
+            compute_mask=compute_mask, wgrad_tap=tp("main"))
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
@@ -499,22 +522,23 @@ class RAFTStereo(nn.Module):
         else:
             carry = (tuple(net_list), coords1)
 
-        # Rematerialize each refinement iteration: without this, the scan
-        # stores every iteration's GRU/conv activations for the backward pass
-        # (~0.6 GB per conv buffer at the SceneFlow train shape, 22 iters) and
-        # training OOMs on a 16 GB chip. Remat recomputes them from the carry
-        # instead — the jax.checkpoint FLOPs-for-HBM trade.
+        gt_and_mask = None
+        if fused:
+            gt_and_mask = (flow_gt.astype(jnp.float32),
+                           loss_mask.astype(jnp.float32))
+
+        # Selective-save engagement, shared by both backward paths: which
+        # tagged per-iteration values stay resident across the refinement
+        # backward vs being rematerialized (refinement_save_policy_fits has
+        # the measurements; config.refinement_save_policy overrides).
+        engage = False
         if cfg.remat_refinement:
-            # Selective remat: save the fused GRU gate convs and the corr
-            # lookup output across the backward pass, recompute the rest —
-            # but only while the saved residuals fit comfortably (see
-            # refinement_save_policy_fits for the measurements).
-            # config.refinement_save_policy overrides the auto estimate.
             engage = (cfg.refinement_save_policy
                       if cfg.refinement_save_policy is not None else
                       refinement_save_policy_fits(
                           cfg, iters, b, h, w, dt,
-                          fused_lookup=use_fused_lookup))
+                          fused_lookup=use_fused_lookup,
+                          residual_dtype=cfg.residual_dtype))
             if engage == "corr" and use_fused_lookup:
                 # no standalone corr_feats tensor exists on the fused path
                 # (the kernel's backward recomputes from volumes+coords), so
@@ -525,40 +549,86 @@ class RAFTStereo(nn.Module):
                     "fused_lookup (no corr_feats tensor exists to save); "
                     "using full per-iteration remat")
                 engage = False
+
+        if bool(cfg.batched_scan_wgrad) and not self.is_initializing():
+            # Custom-VJP scan (ops/scan_grad.py): the forward runs lax.scan
+            # as usual; the backward runs one reverse scan computing data
+            # gradients only, and the gate convs' weight gradients are
+            # computed after it as single batched contractions over the
+            # iters-stacked (input, cotangent) pairs — replacing 22 small
+            # accumulating weight-grad convs per conv with one MXU-shaped
+            # op. Init still goes through the nn.scan branch below, which
+            # owns parameter creation; here the refinement params are read
+            # back and threaded through the pure scan so cotangents flow.
+            from raft_stereo_tpu.ops.scan_grad import refinement_scan
+            params_ref = self.scope.get_variable("params", "refinement")
+            if params_ref is None:
+                raise ValueError(
+                    "batched_scan_wgrad needs initialized 'refinement' "
+                    "params (init the model before apply)")
+            save_kinds = set()
             if engage == "corr":
-                # Save ONLY the corr lookup output: ~iters*B*h*w*36 values
-                # (~180 MB bf16 at SceneFlow b8 — vs ~2.7 GB for the full
-                # set), so the backward skips re-gathering the 4-level
-                # pyramid while the gate convs still rematerialize.
-                body = nn.remat(
-                    RefinementStep, prevent_cse=False,
-                    policy=jax.checkpoint_policies.save_only_these_names(
-                        "corr_feats"))
+                save_kinds = {"corr"}
             elif engage:
-                body = nn.remat(
-                    RefinementStep, prevent_cse=False,
-                    policy=jax.checkpoint_policies.save_only_these_names(
-                        "gru_zr", "gru_q", "corr_feats"))
-            else:
-                body = nn.remat(RefinementStep, prevent_cse=False)
+                save_kinds = {"zr", "q", "corr"}
+            if use_fused_lookup:
+                save_kinds.discard("corr")
+            refine = RefinementStep(cfg, test_mode, fused, deferred, dt,
+                                    fused_lookup=use_fused_lookup,
+                                    parent=None)
+            carry, flow_predictions = refinement_scan(
+                refine, params_ref, carry,
+                (corr_state, tuple(inp_list), coords0, gt_and_mask),
+                length=iters, save_kinds=frozenset(save_kinds),
+                residual_dtype=cfg.residual_dtype, unroll=cfg.scan_unroll)
         else:
-            body = RefinementStep
-        step = nn.scan(
-            body,
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, nn.broadcast),
-            out_axes=0,
-            length=iters,
-            unroll=cfg.scan_unroll,
-        )(cfg, test_mode, fused, deferred, dt,
-          fused_lookup=use_fused_lookup, name="refinement")
-        gt_and_mask = None
-        if fused:
-            gt_and_mask = (flow_gt.astype(jnp.float32),
-                           loss_mask.astype(jnp.float32))
-        carry, flow_predictions = step(carry, corr_state, tuple(inp_list),
-                                       coords0, gt_and_mask)
+            # Rematerialize each refinement iteration: without this, the
+            # scan stores every iteration's GRU/conv activations for the
+            # backward pass (~0.6 GB per conv buffer at the SceneFlow train
+            # shape, 22 iters) and training OOMs on a 16 GB chip. Remat
+            # recomputes them from the carry instead — the jax.checkpoint
+            # FLOPs-for-HBM trade.
+            if cfg.remat_refinement:
+                if engage == "corr":
+                    # Save ONLY the corr lookup output: ~iters*B*h*w*36
+                    # values (~180 MB bf16 at SceneFlow b8 — vs ~2.7 GB for
+                    # the full set), so the backward skips re-gathering the
+                    # 4-level pyramid while the gate convs rematerialize.
+                    body = nn.remat(
+                        RefinementStep, prevent_cse=False,
+                        policy=jax.checkpoint_policies.save_only_these_names(
+                            "corr_feats"))
+                elif engage:
+                    body = nn.remat(
+                        RefinementStep, prevent_cse=False,
+                        policy=jax.checkpoint_policies.save_only_these_names(
+                            "gru_zr", "gru_q", "corr_feats"))
+                else:
+                    body = nn.remat(RefinementStep, prevent_cse=False)
+            else:
+                body = RefinementStep
+            # residual_dtype narrows the TAGGED saves only while a policy
+            # actually keeps them (otherwise the cast-through would perturb
+            # the forward for zero memory gain): corr_feats under both
+            # policies, the gate tags under the full set only.
+            save_dt = cfg.residual_dtype if engage else None
+            gate_save_dt = (cfg.residual_dtype
+                            if engage and engage != "corr" else None)
+            step = nn.scan(
+                body,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast,
+                         nn.broadcast),
+                out_axes=0,
+                length=iters,
+                unroll=cfg.scan_unroll,
+            )(cfg, test_mode, fused, deferred, dt,
+              fused_lookup=use_fused_lookup, save_dtype=save_dt,
+              gate_save_dtype=gate_save_dt, name="refinement")
+            carry, flow_predictions = step(carry, corr_state,
+                                           tuple(inp_list), coords0,
+                                           gt_and_mask)
 
         if deferred:
             lowres, masks = flow_predictions  # (it,B,h,w,1), (it,B,h,w,9f^2)

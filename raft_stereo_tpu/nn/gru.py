@@ -57,25 +57,50 @@ def split_conv_engages(height: int, width: int) -> bool:
     return height * width >= _SPLIT_CONV_MIN_AREA
 
 
-def _split_input_conv(parts, kernel, bias, pad, dt):
+def _split_input_conv(parts, kernel, bias, pad, dt, tap=None, path=None,
+                      kind=None):
     """``conv(concat(parts), kernel) + bias``; computed as a sum of per-part
     convs against input-channel slices of ``kernel`` (no concat tensor) at
-    large spatial sizes, as the plain concat conv at small ones."""
+    large spatial sizes, as the plain concat conv at small ones.
+
+    ``tap`` (a scoped :class:`~raft_stereo_tpu.ops.scan_grad._ScopedTap`)
+    reroutes the conv through the custom-VJP scan's site machinery: the
+    batched-weight-grad backward collects the (post-collapse) input parts
+    and the output cotangent there instead of running a per-iteration
+    weight-grad conv. The primal value is identical either way."""
     h, w = parts[0].shape[1], parts[0].shape[2]
     if not split_conv_engages(h, w):
         # degenerate to one concat conv via the same loop below
         parts = [jnp.concatenate([v.astype(dt) for v in parts], axis=-1)]
+    parts = [v.astype(dt) for v in parts]
+    if tap is not None:
+        return tap.gate_conv(path, kind, parts, kernel, bias, pad)
     out = None
     off = 0
     for v in parts:
         c = v.shape[-1]
         y = jax.lax.conv_general_dilated(
-            v.astype(dt), kernel[:, :, off:off + c, :], (1, 1),
+            v, kernel[:, :, off:off + c, :], (1, 1),
             ((pad, pad), (pad, pad)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         out = y if out is None else out + y
         off += c
     return out + bias
+
+
+def tag_residual(x, name, save_dtype=None):
+    """``checkpoint_name`` with an optional lean storage dtype.
+
+    With ``save_dtype`` set (``config.residual_dtype`` while a selective
+    save policy is engaged on the autodiff path), the TAGGED tensor — the
+    one ``save_only_these_names`` keeps across the scan backward — is the
+    narrowed copy, and downstream compute continues from its upcast. This
+    halves the named residual stacks at the cost of one rounding on the
+    saved value (the documented-tolerance regime; the custom-VJP scan
+    instead narrows only its saved copies and leaves the forward exact)."""
+    if save_dtype is None or x.dtype == jnp.dtype(save_dtype):
+        return checkpoint_name(x, name)
+    return checkpoint_name(x.astype(save_dtype), name).astype(x.dtype)
 
 
 class FlowHead(nn.Module):
@@ -123,12 +148,14 @@ class ConvGRU(nn.Module):
     hidden_dim: int
     kernel_size: int = 3
     dtype: Optional[Dtype] = None
+    save_dtype: Optional[Dtype] = None
 
     @nn.compact
-    def __call__(self, h, cz, cr, cq, *x_list):
+    def __call__(self, h, cz, cr, cq, *x_list, tap=None):
         k, p = self.kernel_size, self.kernel_size // 2
         parts = [h, *x_list]
         in_ch = sum(v.shape[-1] for v in parts)
+        path = tuple(self.scope.path)
 
         kz, bz = _ConvParams((k, k), in_ch, self.hidden_dim, name="convz")()
         kr, br = _ConvParams((k, k), in_ch, self.hidden_dim, name="convr")()
@@ -140,18 +167,19 @@ class ConvGRU(nn.Module):
         # contracts against its slice of the kernel, and the concatenated
         # activation tensor — whose layout copy showed up at ~1 ms/iteration
         # in profiles — never materializes.
-        zr = _split_input_conv(parts, kernel, bias, p, dt)
+        zr = _split_input_conv(parts, kernel, bias, p, dt, tap, path, "zr")
         # gru_zr/gru_q tags feed the size-conditional save policy in
         # models/raft_stereo.py (save_only_these_names when the estimated
         # residuals fit; full remat otherwise — PERF.md r2 inversion).
-        zr = checkpoint_name(zr, "gru_zr")
+        # Inert under the custom-VJP scan, which stacks these sites itself.
+        zr = tag_residual(zr, "gru_zr", self.save_dtype)
         z, r = jnp.split(zr, 2, axis=-1)
         z = nn.sigmoid(z + cz)
         r = nn.sigmoid(r + cr)
         kq, bq = _ConvParams((k, k), in_ch, self.hidden_dim, name="convq")()
         q = _split_input_conv([r * h, *x_list], kq.astype(dt),
-                              bq.astype(dt), p, dt)
-        q = checkpoint_name(q, "gru_q")
+                              bq.astype(dt), p, dt, tap, path, "q")
+        q = tag_residual(q, "gru_q", self.save_dtype)
         q = nn.tanh(q + cq)
         return (1 - z) * h + z * q
 
@@ -256,36 +284,41 @@ class BasicMultiUpdateBlock(nn.Module):
 
     cfg: RAFTStereoConfig
     dtype: Optional[Dtype] = None
+    save_dtype: Optional[Dtype] = None
 
     @nn.compact
     def __call__(self, net: Tuple, inp: Tuple, corr=None, flow=None, *,
                  iter08: bool = True, iter16: bool = True, iter32: bool = True,
                  update: bool = True, corr_state=None, coords_x=None,
-                 compute_mask: bool = True):
+                 compute_mask: bool = True, wgrad_tap=None):
         cfg = self.cfg
         d = self.dtype
+        sd = self.save_dtype
+        tap = wgrad_tap
         hd = cfg.hidden_dims
         net = list(net)
 
         if iter32:
-            net[2] = ConvGRU(hd[0], dtype=d, name="gru32")(
-                net[2], *inp[2], pool2x(net[1]))
+            net[2] = ConvGRU(hd[0], dtype=d, save_dtype=sd, name="gru32")(
+                net[2], *inp[2], pool2x(net[1]), tap=tap)
         if iter16:
             if cfg.n_gru_layers > 2:
-                net[1] = ConvGRU(hd[1], dtype=d, name="gru16")(
-                    net[1], *inp[1], pool2x(net[0]), interp_to(net[2], net[1]))
+                net[1] = ConvGRU(hd[1], dtype=d, save_dtype=sd, name="gru16")(
+                    net[1], *inp[1], pool2x(net[0]), interp_to(net[2], net[1]),
+                    tap=tap)
             else:
-                net[1] = ConvGRU(hd[1], dtype=d, name="gru16")(
-                    net[1], *inp[1], pool2x(net[0]))
+                net[1] = ConvGRU(hd[1], dtype=d, save_dtype=sd, name="gru16")(
+                    net[1], *inp[1], pool2x(net[0]), tap=tap)
         if iter08:
             motion = BasicMotionEncoder(cfg, dtype=d, name="encoder")(
                 flow, corr, corr_state=corr_state, coords_x=coords_x)
             if cfg.n_gru_layers > 1:
-                net[0] = ConvGRU(hd[2], dtype=d, name="gru08")(
-                    net[0], *inp[0], motion, interp_to(net[1], net[0]))
+                net[0] = ConvGRU(hd[2], dtype=d, save_dtype=sd, name="gru08")(
+                    net[0], *inp[0], motion, interp_to(net[1], net[0]),
+                    tap=tap)
             else:
-                net[0] = ConvGRU(hd[2], dtype=d, name="gru08")(
-                    net[0], *inp[0], motion)
+                net[0] = ConvGRU(hd[2], dtype=d, save_dtype=sd, name="gru08")(
+                    net[0], *inp[0], motion, tap=tap)
 
         if not update:
             return tuple(net)

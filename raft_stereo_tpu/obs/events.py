@@ -27,20 +27,22 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # Back-compat: every schema version whose artifacts are still readable.
-# v1 -> v2 was purely ADDITIVE (the xla_memory/xla_cost introspection
-# events; no v1 event changed its required fields), so pre-existing
-# runs/*/events.jsonl lint clean — a v1 record is validated against the
-# v1 surface (it just may not use events introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2)
+# v1 -> v2 (the xla_memory/xla_cost introspection events) and v2 -> v3 (the
+# op_counts jaxpr profile event) were purely ADDITIVE — no earlier event
+# changed its required fields — so pre-existing runs/*/events.jsonl lint
+# clean: an older record is validated against its own surface (it just may
+# not use events introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
 _EVENT_MIN_VERSION: Dict[str, int] = {
     "xla_memory": 2,
     "xla_cost": 2,
+    "op_counts": 3,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -70,6 +72,12 @@ EVENT_TYPES: Dict[str, tuple] = {
     # accessed, flops_per_byte).
     "xla_memory": ("source", "peak_bytes"),
     "xla_cost": ("source", "flops"),
+    # Jaxpr-level conv placement profile (obs/xla.py conv_op_profile):
+    # convs per scan body vs outside any scan — the structural evidence for
+    # scheduling claims like the batched-weight-grad scan's "22 per-
+    # iteration wgrad convs replaced by post-scan contractions"
+    # (scripts/scan_wgrad_evidence.py).
+    "op_counts": ("source", "conv_total"),
     "stall": ("seconds_since_step", "deadline_s"),
     "error": ("error",),
     "run_end": ("steps",),

@@ -168,6 +168,88 @@ def compact_xla_summary(analysis: Dict[str, Optional[Dict[str, Any]]]
     return out or None
 
 
+# --- jaxpr op profiles -------------------------------------------------------
+#
+# Backend-independent structural evidence for scheduling claims: where do the
+# convolutions LIVE — inside a scan's while-loop body (executed once per
+# iteration) or at the top level (executed once per step)? The batched-
+# weight-grad scan (ops/scan_grad.py) claims to move the per-iteration
+# weight-grad convs out of the backward loop; this profile is the artifact
+# that shows it (scripts/scan_wgrad_evidence.py, `op_counts` events), without
+# needing a TPU or even an XLA compile.
+
+def _iter_subjaxprs(params):
+    import jax.core as jcore
+    for v in params.values():
+        vals = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vals:
+            if isinstance(item, jcore.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, jcore.Jaxpr):
+                yield item
+
+
+def _count_convs(jaxpr) -> int:
+    """Total conv_general_dilated eqns in a jaxpr, recursing through every
+    sub-jaxpr (pjit/remat/custom_vjp/cond/while/scan bodies)."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "conv_general_dilated":
+            n += 1
+        for sub in _iter_subjaxprs(eqn.params):
+            n += _count_convs(sub)
+    return n
+
+
+def conv_op_profile(closed_jaxpr) -> Dict[str, Any]:
+    """Profile conv placement: per-scan body counts vs everything outside.
+
+    Returns ``{"outside_scans": N, "scans": [{"length", "convs",
+    "convs_per_step"}...], "total": N}`` where ``convs_per_step`` counts the
+    convs one loop iteration executes and ``total`` weights each scan body
+    by 1 (static op count). Scans are listed in jaxpr order: for a
+    ``value_and_grad`` train step the forward refinement scan comes first
+    and the backward (reverse) scan last — the one whose per-step conv
+    count the batched-weight-grad path shrinks."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    scans: List[Dict[str, Any]] = []
+
+    def walk(jxp) -> int:
+        outside = 0
+        for eqn in jxp.eqns:
+            if eqn.primitive.name == "conv_general_dilated":
+                outside += 1
+            elif eqn.primitive.name == "scan":
+                body = eqn.params["jaxpr"].jaxpr
+                convs = _count_convs(body)
+                scans.append({"length": int(eqn.params.get("length", 0)),
+                              "convs_per_step": convs, "convs": convs})
+            else:
+                for sub in _iter_subjaxprs(eqn.params):
+                    outside += walk(sub)
+        return outside
+
+    outside = walk(jaxpr)
+    return {"outside_scans": outside, "scans": scans,
+            "total": outside + sum(s["convs"] for s in scans)}
+
+
+def emit_op_counts(profile: Dict[str, Any], telemetry=None,
+                   source: str = "op_profile",
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Flatten a :func:`conv_op_profile` into one ``op_counts`` event."""
+    rec = {
+        "conv_total": profile["total"],
+        "conv_outside_scans": profile["outside_scans"],
+        "scan_convs_per_step": [s["convs_per_step"]
+                                for s in profile["scans"]],
+        "scan_lengths": [s["length"] for s in profile["scans"]],
+    }
+    if telemetry is not None:
+        telemetry.emit("op_counts", source=source, **rec, **(extra or {}))
+    return rec
+
+
 # --- buffer-assignment dumps ------------------------------------------------
 #
 # Line shapes in an XLA *buffer-assignment.txt (any backend):

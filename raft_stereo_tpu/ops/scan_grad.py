@@ -1,0 +1,441 @@
+"""Custom-VJP refinement scan: batched weight gradients, lean residuals.
+
+The refinement backward is the step's biggest bucket (~347 ms of 819 at the
+r4 banker, PERF.md), and ~1.1 ms/iter of it is weight-gradient convolutions:
+autodiff-through-``lax.scan`` computes each gate conv's kernel gradient once
+per iteration and accumulates 22 small ``(3,3,Cin,Cout)`` contractions in the
+backward while-loop. This module restructures that backward (the standard
+trick for recurrent nets — Martin & Cundy, arXiv:1709.04057, applied to
+RAFT's refinement GRU):
+
+* the **forward** runs ``lax.scan`` exactly as the autodiff path does and
+  additionally stacks the per-iteration carries (and, when the selective
+  save policy engages, the tagged ``gru_zr``/``gru_q``/``corr_feats``
+  values) as explicit residuals;
+* the **backward** runs ONE reverse ``lax.scan`` computing only *data*
+  gradients — the cotangent chain through the carry plus the per-iteration
+  gradients of everything that is not a deferred conv weight — while
+  emitting each deferred conv's ``(input parts, output cotangent)`` pair as
+  stacked outputs;
+* the **weight gradients** of the deferred convs (the fused z/r gate conv
+  and the q conv of every ConvGRU application) are then computed OUTSIDE the
+  loop as one batched contraction each over the ``(iters*B, H, W, C)``
+  merged stacks — one MXU-shaped conv-wgrad per conv instead of ``iters``
+  accumulating small ones.
+
+Cotangents of the deferred conv outputs are captured with the standard
+zero-perturbation trick: the backward-pass recompute adds a zeros tensor
+``eps`` to each deferred conv's output and the per-step VJP is taken with
+respect to ``eps`` — ``d eps`` IS the conv-output cotangent, with no change
+to any primal value.
+
+Residual precision (``config.residual_dtype``): the stacked residuals this
+path materializes — carry hidden states, tap input/cotangent stacks, and
+policy save-stacks — are exactly the allocation class the r7 breakdown named
+dominant (``[22,B,80,180,128..144]``); storing them in bf16 halves it while
+the batched contractions still accumulate in fp32
+(``preferred_element_type``). The knob never changes this path's *forward*
+numerics (only saved copies are rounded); on the autodiff path the same knob
+rounds the tagged saves through bf16 in the forward (one rounding on the
+saved tensors, ``nn/gru.py``), which is why its gradient contract is
+documented-tolerance rather than exact.
+
+Gradient contract (pinned in tests/test_scan_grad.py): with fp32 residuals
+the custom VJP matches autodiff-through-``lax.scan`` to accumulation-order
+tolerance (the batched contraction sums the iteration axis inside one conv
+reduction instead of 22 ordered adds); with bf16 residuals it matches within
+the documented bf16 tolerance. Everything here is standard traceable JAX, so
+the custom VJP composes with ``jit``/``shard_map``/auto-SPMD ``pjit`` and
+buffer donation unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, FrozenSet, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.ops.corr import corr_lookup
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv_wgrad(x, g, pad: int):
+    """Weight gradient of a stride-1 NHWC/HWIO conv as ONE contraction.
+
+    ``dK[kh,kw,ci,co] = sum_{n,oh,ow} x[n,oh+kh-pad,ow+kw-pad,ci] *
+    g[n,oh,ow,co]`` — the batch axis (here ``iters*B``) is contracted
+    *inside* the conv, which is what turns 22 accumulating per-iteration
+    wgrads into one MXU-shaped op. Accumulates fp32 regardless of the
+    stack dtype."""
+    return jax.lax.conv_general_dilated(
+        x, g, window_strides=(1, 1), padding=((pad, pad), (pad, pad)),
+        dimension_numbers=("CHWN", "IHWO", "HWNC"),
+        preferred_element_type=jnp.float32)
+
+
+def _conv_parts(parts, kernel, pad: int):
+    """``conv(concat(parts), kernel)`` as summed per-slice convs (the
+    split-input formulation of nn/gru.py, without the bias)."""
+    out = None
+    off = 0
+    for v in parts:
+        c = v.shape[-1]
+        y = jax.lax.conv_general_dilated(
+            v, kernel[:, :, off:off + c, :], (1, 1),
+            ((pad, pad), (pad, pad)), dimension_numbers=_DIMNUMS)
+        out = y if out is None else out + y
+        off += c
+    return out
+
+
+# --- the replay op: skip a saved conv's forward recompute --------------------
+#
+# Mirrors ``save_only_these_names("gru_zr", "gru_q")`` semantics for the
+# custom backward: the conv's output comes from the forward's save stack (so
+# the MXU matmul is not recomputed), the data gradient to the input parts is
+# still produced (conv is linear — its input-cotangent needs only the kernel
+# and the output cotangent, never the input values), and the weight/bias
+# cotangents are structurally zero because they are deferred to the batched
+# post-scan contraction.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _replay_conv(spec, parts, kernel, eps, saved):
+    del spec, parts, kernel
+    return saved + eps
+
+
+def _replay_conv_fwd(spec, parts, kernel, eps, saved):
+    del parts
+    return saved + eps, (kernel,)
+
+
+def _replay_conv_bwd(spec, res, g):
+    (kernel,) = res
+    pad, part_specs = spec
+    structs = tuple(jax.ShapeDtypeStruct(s, jnp.dtype(d))
+                    for s, d in part_specs)
+    (dparts,) = jax.linear_transpose(
+        lambda ps: _conv_parts(ps, kernel, pad), structs)(g)
+    return (tuple(dparts), jnp.zeros_like(kernel), g, jnp.zeros_like(g))
+
+
+_replay_conv.defvjp(_replay_conv_fwd, _replay_conv_bwd)
+
+
+@jax.custom_vjp
+def _replay_value(computed, saved):
+    """Use ``saved`` in place of ``computed``'s value while routing the
+    cotangent back through ``computed``'s producers (the corr-lookup replay:
+    the forward gather is skipped, the scatter backward into the volume
+    pyramid still runs)."""
+    del computed
+    return saved
+
+
+def _replay_value_fwd(computed, saved):
+    del computed
+    return saved, None
+
+
+def _replay_value_bwd(_, g):
+    return (g, jnp.zeros_like(g))
+
+
+_replay_value.defvjp(_replay_value_fwd, _replay_value_bwd)
+
+
+# --- tap objects threaded through the refinement module ----------------------
+
+class _ScopedTap:
+    """Per-application view of a tap: prefixes site keys so the slow_fast
+    pre-iterations and the main update — which share module paths and
+    params — get distinct residual stacks."""
+
+    def __init__(self, tap, prefix: str):
+        self._tap = tap
+        self._prefix = prefix
+
+    def gate_conv(self, path, kind, parts, kernel, bias, pad):
+        key = f"{self._prefix}/{'/'.join(path)}/{kind}"
+        return self._tap.gate_conv(key, tuple(path), kind, parts, kernel,
+                                   bias, pad)
+
+
+class _TapBase:
+    """Shared traversal contract. ``gate_conv`` must return exactly what the
+    plain split-input conv would (same value in probe/save modes), and every
+    mode must visit sites in the same deterministic order so keys line up."""
+
+    def scoped(self, prefix: str) -> _ScopedTap:
+        return _ScopedTap(self, prefix)
+
+    def _plain(self, parts, kernel, bias, pad):
+        return _conv_parts(parts, kernel, pad) + bias
+
+
+class ProbeTap(_TapBase):
+    """Abstract-eval pass collecting per-site static metadata (shapes,
+    dtypes, param paths) — run once under ``jax.eval_shape``."""
+
+    def __init__(self):
+        self.meta: Dict[str, Dict[str, Any]] = {}
+
+    def gate_conv(self, key, path, kind, parts, kernel, bias, pad):
+        out = self._plain(parts, kernel, bias, pad)
+        self.meta[key] = dict(
+            path=path, kind=kind, pad=pad,
+            part_specs=tuple((tuple(p.shape), p.dtype.name) for p in parts),
+            out_shape=tuple(out.shape), out_dtype=out.dtype.name)
+        return out
+
+    def corr_site(self, corr_state, coords, cast_dtype):
+        corr = corr_lookup(corr_state, coords)
+        if cast_dtype is not None:
+            corr = corr.astype(cast_dtype)
+        self.meta["corr"] = dict(kind="corr", out_shape=tuple(corr.shape),
+                                 out_dtype=corr.dtype.name)
+        return corr
+
+
+class SaveTap(_TapBase):
+    """Forward-scan tap: compute every site normally, record the outputs the
+    engaged save policy keeps (they become stacked scan outputs — the
+    explicit form of the autodiff path's named residual stacks)."""
+
+    def __init__(self, save_kinds: FrozenSet[str]):
+        self.save_kinds = save_kinds
+        self.saves: Dict[str, jax.Array] = {}
+
+    def gate_conv(self, key, path, kind, parts, kernel, bias, pad):
+        out = self._plain(parts, kernel, bias, pad)
+        if kind in self.save_kinds:
+            self.saves[key] = out
+        return out
+
+    def corr_site(self, corr_state, coords, cast_dtype):
+        corr = corr_lookup(corr_state, coords)
+        if cast_dtype is not None:
+            corr = corr.astype(cast_dtype)
+        if "corr" in self.save_kinds:
+            self.saves["corr"] = corr
+        return corr
+
+
+class BwdTap(_TapBase):
+    """Backward-recompute tap: inject the ``eps`` perturbation on every
+    deferred conv output (its VJP is the conv's output cotangent), collect
+    the conv input parts for the batched wgrad, stop weight gradients at
+    the per-step level, and substitute saved values where the policy stacked
+    them in the forward."""
+
+    def __init__(self, eps: Dict[str, jax.Array],
+                 replay: Dict[str, jax.Array]):
+        self.eps = eps
+        self.replay = replay
+        self.inputs: Dict[str, Tuple[jax.Array, ...]] = {}
+
+    def gate_conv(self, key, path, kind, parts, kernel, bias, pad):
+        parts = tuple(parts)
+        self.inputs[key] = parts
+        saved = self.replay.get(key)
+        if saved is not None:
+            spec = (pad, tuple((tuple(p.shape), p.dtype.name)
+                               for p in parts))
+            return _replay_conv(spec, parts, kernel, self.eps[key], saved)
+        sg = jax.lax.stop_gradient
+        out = _conv_parts(parts, sg(kernel), pad) + sg(bias)
+        return out + self.eps[key]
+
+    def corr_site(self, corr_state, coords, cast_dtype):
+        saved = self.replay.get("corr")
+        if saved is None:
+            corr = corr_lookup(corr_state, coords)
+            return corr.astype(cast_dtype) if cast_dtype is not None else corr
+
+        # Keep the volume-pyramid gradient path alive while the forward
+        # gather's *value* is replayed from the save stack: the computed
+        # branch exists only for its cotangent (its forward output is dead
+        # past _replay_value, so XLA's DCE drops the gather while the
+        # scatter backward into d_volumes remains).
+        corr = corr_lookup(corr_state, coords)
+        if cast_dtype is not None:
+            corr = corr.astype(cast_dtype)
+        return _replay_value(corr, saved)
+
+
+# --- residual casting --------------------------------------------------------
+
+def _cast_carry(carry, rd):
+    """Residual-dtype cast of a refinement carry for the save stack: only
+    the hidden-state tuple (``carry[0]``) is narrowed — ``coords1`` (and the
+    fused path's ``flow_up``) carry sub-pixel positions whose bf16 rounding
+    would be a real precision loss, and they are a few channels against the
+    net's hundreds."""
+    if rd is None:
+        return carry
+    return (tuple(h.astype(rd) for h in carry[0]),) + tuple(carry[1:])
+
+
+def _uncast_carry(carry, like):
+    """Restore a save-stack carry to the compute dtypes of ``like``."""
+    return (tuple(h.astype(l.dtype) for h, l in zip(carry[0], like[0])),) \
+        + tuple(c.astype(l.dtype) for c, l in zip(carry[1:], like[1:]))
+
+
+def _cast_tree(tree, rd):
+    if rd is None:
+        return tree
+    return jax.tree.map(lambda a: a.astype(rd), tree)
+
+
+def _tree_add_leaf(node, path, delta):
+    """Functionally add ``delta`` at ``path`` (a tuple of dict keys) in a
+    nested-dict param tree, preserving container types."""
+    if not path:
+        return (node + delta).astype(node.dtype)
+    key = path[0]
+    child = _tree_add_leaf(node[key], path[1:], delta)
+    if hasattr(node, "copy") and not isinstance(node, dict):
+        return node.copy({key: child})  # FrozenDict
+    new = dict(node)
+    new[key] = child
+    return new
+
+
+# --- the scan ----------------------------------------------------------------
+
+def refinement_scan(module, params, carry, broadcasts, *, length: int,
+                    save_kinds: FrozenSet[str] = frozenset(),
+                    residual_dtype: Optional[Any] = None, unroll: int = 1):
+    """Run ``length`` refinement iterations with the custom batched-wgrad VJP.
+
+    Args:
+      module: a detached (``parent=None``) ``RefinementStep`` whose
+        ``__call__(carry, corr_state, inp_list, coords0, gt_and_mask,
+        wgrad_tap=...)`` returns ``(carry, y)``.
+      params: the ``refinement`` params subtree (arrays flow from the
+        caller's traced params, so cotangents reach the training step).
+      carry: initial scan carry ``(net_tuple, coords1[, flow_up])``.
+      broadcasts: ``(corr_state, inp_list, coords0, gt_and_mask)`` —
+        iteration-invariant inputs whose cotangents accumulate across the
+        reverse scan (the volume pyramid's feeds the encoders).
+      length: iteration count (static).
+      save_kinds: subset of ``{"zr", "q", "corr"}`` — which tagged values
+        the forward stacks so the backward skips recomputing them (the
+        custom-path form of ``refinement_save_policy``).
+      residual_dtype: optional storage dtype for every stacked residual
+        this scan materializes (fp32 accumulation is preserved in the
+        batched contractions).
+      unroll: ``lax.scan`` unroll factor, both directions.
+
+    Returns:
+      ``(final_carry, ys)`` exactly as the ``nn.scan`` path would.
+    """
+    rd = jnp.dtype(residual_dtype) if residual_dtype is not None else None
+
+    def apply_step(p, c, bc, tap):
+        corr_state, inp_list, coords0, gt_and_mask = bc
+        return module.apply({"params": p}, c, corr_state, inp_list, coords0,
+                            gt_and_mask, wgrad_tap=tap)
+
+    # One abstract pass collects the static site metadata (eps shapes, param
+    # paths, part layouts) that the backward needs before any tracing of it.
+    probe = ProbeTap()
+    jax.eval_shape(lambda p, c, bc: apply_step(p, c, bc, probe),
+                   params, carry, broadcasts)
+    meta = probe.meta
+    gate_keys = tuple(k for k, m in meta.items() if m["kind"] != "corr")
+
+    @jax.custom_vjp
+    def scan_fn(params, carry, bc):
+        def body(c, _):
+            c2, y = apply_step(params, c, bc, None)
+            return c2, y
+        return jax.lax.scan(body, carry, None, length=length, unroll=unroll)
+
+    def scan_fwd(params, carry, bc):
+        save_tap = bool(save_kinds)
+
+        def body(c, _):
+            tap = SaveTap(save_kinds) if save_tap else None
+            c2, y = apply_step(params, c, bc, tap)
+            saves = _cast_tree(tap.saves if save_tap else {}, rd)
+            return c2, (y, _cast_carry(c, rd), saves)
+
+        final, (ys, carries, saves) = jax.lax.scan(
+            body, carry, None, length=length, unroll=unroll)
+        return (final, ys), (params, bc, carry, carries, saves)
+
+    def scan_bwd(res, cot):
+        params, bc, carry0, carries, saves = res
+        d_final, d_ys = cot
+        eps0 = {k: jnp.zeros(meta[k]["out_shape"],
+                             jnp.dtype(meta[k]["out_dtype"]))
+                for k in gate_keys}
+
+        def f(p, c, x, e, replay):
+            tap = BwdTap(e, replay)
+            c2, y = apply_step(p, c, x, tap)
+            return (c2, y), tap.inputs
+
+        def body(acc, xs):
+            dc, dp_acc, dbc_acc = acc
+            c_t, dy_t, saves_t = xs
+            c_t = _uncast_carry(c_t, carry0)
+            replay = {k: v.astype(jnp.dtype(meta[k]["out_dtype"]))
+                      for k, v in saves_t.items()}
+            _, pullback, taps_in = jax.vjp(
+                lambda p, c, x, e: f(p, c, x, e, replay),
+                params, c_t, bc, eps0, has_aux=True)
+            dp_t, dc_t, dbc_t, deps_t = pullback((dc, dy_t))
+            acc = (dc_t,
+                   jax.tree.map(jnp.add, dp_acc, dp_t),
+                   jax.tree.map(jnp.add, dbc_acc, dbc_t))
+            return acc, (_cast_tree(taps_in, rd), _cast_tree(deps_t, rd))
+
+        init = (d_final,
+                jax.tree.map(jnp.zeros_like, params),
+                jax.tree.map(jnp.zeros_like, bc))
+        (dc0, dp, dbc), (x_stacks, g_stacks) = jax.lax.scan(
+            body, init, (carries, d_ys, saves), reverse=True, unroll=unroll)
+
+        # The deferred weight gradients: one batched contraction per conv
+        # over the (iters*B)-merged stacks, summed across applications that
+        # share parameters (slow_fast pre-iterations), then scattered into
+        # the otherwise-complete accumulated param cotangents.
+        contribs: Dict[Tuple[Tuple[str, ...], str],
+                       Tuple[jax.Array, jax.Array]] = {}
+        for key in gate_keys:
+            m = meta[key]
+            gs = g_stacks[key]
+            gm = gs.reshape((-1,) + m["out_shape"][1:])
+            dks = []
+            for (shape, _dt), xs_part in zip(m["part_specs"],
+                                             x_stacks[key]):
+                xm = xs_part.reshape((-1,) + shape[1:])
+                dks.append(conv_wgrad(xm, gm, m["pad"]))
+            dk = jnp.concatenate(dks, axis=2)
+            db = jnp.sum(gm.astype(jnp.float32), axis=(0, 1, 2))
+            prev = contribs.get((m["path"], m["kind"]))
+            if prev is not None:
+                dk, db = dk + prev[0], db + prev[1]
+            contribs[(m["path"], m["kind"])] = (dk, db)
+
+        for (path, kind), (dk, db) in contribs.items():
+            if kind == "zr":
+                hd = dk.shape[-1] // 2
+                targets = ((path + ("convz",), dk[..., :hd], db[:hd]),
+                           (path + ("convr",), dk[..., hd:], db[hd:]))
+            else:
+                targets = ((path + ("convq",), dk, db),)
+            for ppath, dkp, dbp in targets:
+                dp = _tree_add_leaf(dp, ppath + ("kernel",), dkp)
+                dp = _tree_add_leaf(dp, ppath + ("bias",), dbp)
+
+        return dp, dc0, dbc
+
+    scan_fn.defvjp(scan_fwd, scan_bwd)
+    return scan_fn(params, carry, broadcasts)

@@ -19,6 +19,17 @@ Two complementary paths, both over the same :mod:`mesh`:
 Multi-host: both paths extend across hosts by initializing
 ``jax.distributed`` and building the mesh from global devices; the collective
 layout is unchanged (psum/halo traffic rides ICI within a slice, DCN across).
+
+Custom-VJP refinement scan (``config.batched_scan_wgrad``): both paths
+compose with it unchanged — the custom scan is standard traceable JAX
+(lax.scan + convs, no custom calls), so under ``shard_map`` its eps/residual
+stacks take per-shard shapes and the psum'd gradients include the batched
+post-scan weight-grad contractions, and under auto-SPMD ``pjit`` the
+partitioner shards the stacks' batch axis like any other activation. No
+fused_lookup-style stripping is needed (that kernel is excluded for a
+missing SPMD *partitioning rule*, not for being a custom VJP).
+Equivalence vs the single-device custom step is pinned in
+tests/test_scan_grad.py::test_shardmap_dp_matches_single_device_custom.
 """
 
 from __future__ import annotations

@@ -64,7 +64,10 @@ def _compile_step_introspected(step_fn, state, placed, tel):
     key) the first jitted dispatch would, but hands back the compiled
     object, whose ``memory_analysis()``/``cost_analysis()`` become
     ``xla_memory``/``xla_cost`` events — peak-HBM headroom and flops/byte
-    are on the run record before the first step executes. Fail-open: any
+    are on the run record before the first step executes. An ``op_counts``
+    event (conv placement: per-scan-body vs outside — the refinement
+    backward's structure, obs/xla.py) rides along so a run permanently
+    records WHICH scan backward it trained with. Fail-open: any
     AOT/introspection failure falls back to the plain jitted callable (one
     logged warning), because observability must never take down the run.
     """
@@ -72,11 +75,18 @@ def _compile_step_introspected(step_fn, state, placed, tel):
         compiled = step_fn.lower(state, placed).compile()
         from raft_stereo_tpu.obs.xla import introspect_compiled
         introspect_compiled(compiled, tel, source="train_step")
-        return compiled
     except Exception:
         logger.warning("AOT step introspection failed; falling back to "
                        "jit dispatch", exc_info=True)
         return step_fn
+    try:
+        from raft_stereo_tpu.obs.xla import conv_op_profile, emit_op_counts
+        emit_op_counts(conv_op_profile(jax.make_jaxpr(step_fn)(state, placed)),
+                       tel, source="train_step")
+    except Exception:
+        logger.warning("op-count introspection failed (continuing)",
+                       exc_info=True)
+    return compiled
 
 
 def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,

@@ -5,9 +5,12 @@ fitted from b2/b4/b8 (r1); the floor amortizes with per-chip batch, and the
 same linear model predicts ~12 pairs/s at b16 — but batch > 8 was never
 measured. This walks b10/b12/b16 at the SceneFlow recipe shape on the real
 chip, per batch trying the banker schedule first (hires-blocks remat + r4
-best schedule) and falling back to the memory-frugal schedule
+best schedule), then the hires_frugal rung (blocks_hires remat with the
+memory-frugal tail/budget defaults — the r8 addition probing whether bf16
+volumes + a lighter graph reopen b12-b16 under the compile-shunt
+threshold, VERDICT r5 weak #5), and finally the memory-frugal schedule
 (remat_encoders=True + rematerialized loss tail + default chunk-on-pressure
-upsample budget) when the banker's residency no longer fits.
+upsample budget) when neither hires graph fits/compiles.
 
 Correlation-volume storage dtype (VERDICT r5 #3): ``run_bench`` has pinned
 ``corr_storage_dtype="bfloat16"`` since r4 (commit 8aa95de), so every ladder
@@ -56,12 +59,23 @@ def main():
     args = p.parse_args()
 
     banker = dict(remat_encoders="blocks_hires", **R4_BEST_SCHEDULE)
+    # The VERDICT r5 weak-#5 rung: blocks_hires remat WITHOUT the r4 best
+    # schedule's saved-tail/one-shot additions (rematerialized loss tail +
+    # chunk-on-pressure budget stay at their memory-frugal defaults). The
+    # r5 ladder only ran banker (shunted at b>=9 — its graph is over the
+    # terminal's broken big-graph compile threshold) and full-encoder-remat
+    # frugal; this middle point is the lightest graph that keeps the
+    # hires-blocks encoder policy, the candidate for reopening b12-b16
+    # with bf16 volumes under the shunt line.
+    hires_frugal = dict(remat_encoders="blocks_hires")
     frugal = dict(remat_encoders=True)  # remat_loss_tail defaults True,
     # upsample_tile_budget defaults to chunk-on-pressure
     best = None
     for b in args.batches:
         for dtype in args.dtypes:
-            for name, sched in (("banker", banker), ("frugal", frugal)):
+            for name, sched in (("banker", banker),
+                                ("hires_frugal", hires_frugal),
+                                ("frugal", frugal)):
                 kw = dict(batch=b, corr_storage_dtype=dtype, **sched,
                           **RECIPE)
                 result, err, wall = run_attempt_subprocess_detailed(
@@ -84,7 +98,7 @@ def main():
                 if result is not None:
                     if best is None or result["value"] > best[3]:
                         best = (b, name, dtype, result["value"])
-                    break  # banker fits at this batch; frugal not needed
+                    break  # heaviest fitting schedule wins; skip lighter ones
     _log({"done": True,
           "best": None if best is None else
           {"batch": best[0], "schedule": best[1],

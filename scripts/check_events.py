@@ -4,16 +4,18 @@
 Validates every record of one or more ``events.jsonl`` files (or run
 directories containing one) against the supported schema versions and each
 event type's required fields — the streaming-eval ``pipeline`` gauge
-(``in_flight`` required) and the v2 compiled-artifact introspection records
+(``in_flight`` required), the v2 compiled-artifact introspection records
 ``xla_memory`` (``source``/``peak_bytes``) and ``xla_cost``
-(``source``/``flops``), which additionally may not claim a schema older
-than their introduction — and exits non-zero on any violation; wired into
-the tier-1 run via tests/test_telemetry.py, tests/test_eval_stream.py and
-tests/test_obs_xla.py so schema drift fails tests instead of silently
-corrupting downstream summarizers.
+(``source``/``flops``), and the v3 jaxpr conv-placement profile
+``op_counts`` (``source``/``conv_total``, the batched-weight-grad scan's
+structural evidence) — newer events additionally may not claim a schema
+older than their introduction — and exits non-zero on any violation; wired
+into the tier-1 run via tests/test_telemetry.py, tests/test_eval_stream.py,
+tests/test_obs_xla.py and tests/test_scan_grad.py so schema drift fails
+tests instead of silently corrupting downstream summarizers.
 
-Back-compat: v1 -> v2 was additive (obs/events.py
-``SUPPORTED_SCHEMA_VERSIONS``), so pre-existing v1 artifacts lint clean.
+Back-compat: v1 -> v2 -> v3 were additive (obs/events.py
+``SUPPORTED_SCHEMA_VERSIONS``), so pre-existing artifacts lint clean.
 
 Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
 """

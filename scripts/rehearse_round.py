@@ -26,12 +26,20 @@ rehearsal:
   or memory/compile-time regression fails the rehearsal instead of waiting
   for a reviewer to notice. Skipped (ok, with a note) while no baseline
   exists yet.
+* **scangrad** — the scan-gradient-equivalence leg (r8): run the FAST
+  custom-VJP parity tests (tests/test_scan_grad.py, ``-m 'not slow'``,
+  forced onto ``JAX_PLATFORMS=cpu`` so it runs identically on a TPU host)
+  so a gradient regression in the batched-weight-grad backward surfaces
+  before round end; a throughput regression in the same path is what the
+  compare leg gates (the bench chain's scan A/B attempt writes into
+  ``runs/bench/current``).
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
 the rehearsal can gate a round's end ritual.
 
-Run: python scripts/rehearse_round.py [--legs bench multichip events compare]
+Run: python scripts/rehearse_round.py
+     [--legs bench multichip events compare scangrad]
      [--bench-budget S] [--multichip-budget S] [--baseline RUN_DIR]
 """
 
@@ -57,18 +65,20 @@ MULTICHIP_BUDGET_S = float(
     os.environ.get("GRAFT_DRYRUN_DEADLINE_S", "3600")) + 600
 
 
-def run_leg(name, cmd, timeout_s, cwd=REPO, check_stdout=None):
+def run_leg(name, cmd, timeout_s, cwd=REPO, check_stdout=None, env=None):
     """Run one driver command under its budget; return the log record.
 
     ``check_stdout(stdout) -> error_or_None`` validates the artifact the
     driver would capture (e.g. the bench result JSON), because a command
     that exits 0 with an unparseable artifact is still a failed round.
+    ``env``: extra environment entries layered over ``os.environ``.
     """
     t0 = time.monotonic()
+    run_env = None if env is None else {**os.environ, **env}
     try:
         proc = subprocess.run(cmd, cwd=cwd, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True,
-                              timeout=timeout_s)
+                              timeout=timeout_s, env=run_env)
         rc, out = proc.returncode, proc.stdout or ""
     except subprocess.TimeoutExpired as e:
         out = (e.stdout or b"")
@@ -143,9 +153,12 @@ def main(argv=None):
     p = argparse.ArgumentParser(
         description="Rehearse the driver's end-of-round commands under the "
                     "driver's budgets (see module doc)")
-    p.add_argument("--legs", nargs="+", default=["bench", "multichip",
-                                                 "events", "compare"],
-                   choices=["bench", "multichip", "events", "compare"])
+    p.add_argument("--legs", nargs="+",
+                   default=["bench", "multichip", "events", "compare",
+                            "scangrad"],
+                   choices=["bench", "multichip", "events", "compare",
+                            "scangrad"])
+    p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -179,6 +192,12 @@ def main(argv=None):
                         or None})
     if "compare" in args.legs:
         records.append(compare_leg(args.baseline, args.candidate))
+    if "scangrad" in args.legs:
+        records.append(run_leg(
+            "scangrad",
+            [sys.executable, "-m", "pytest", "tests/test_scan_grad.py",
+             "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+            args.scangrad_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Structural evidence for the batched-weight-grad scan (PERF.md r8).
+
+The custom-VJP refinement scan (ops/scan_grad.py) claims to replace the
+autodiff backward's per-iteration weight-grad convolutions with post-scan
+batched contractions, and to shrink the refinement save-stack allocation
+class. This script produces the machine-readable artifacts for both claims,
+on any backend (the jaxpr profile needs no compile at all):
+
+* **op placement** — ``obs.xla.conv_op_profile`` over the jaxpr of the
+  train-step gradient, custom VJP off vs on: convs per scan body (executed
+  once per refinement iteration) vs outside any scan (executed once per
+  step). The autodiff backward scan carries every gate-conv wgrad per
+  iteration; the custom path's reverse scan must show FEWER convs per step
+  while the outside count GROWS by the batched contractions.
+* **memory** — ``memory_analysis()`` of the compiled step (off vs on, same
+  shape), quantifying the residual-stack trade the custom path makes and
+  what ``--residual_dtype bfloat16`` buys back.
+
+Artifacts: dated ``op_counts``/``xla_memory`` events into
+``<out>/events.jsonl`` (schema v3, linted by scripts/check_events.py) plus
+one human-readable JSON summary on stdout and at ``<out>/summary.json``.
+
+Run (CPU is fine): python scripts/scan_wgrad_evidence.py
+     [--batch 1 --h 64 --w 96 --iters 8] [--no-compile]
+     [--residual_dtype bfloat16] [--out runs/scan_grad_evidence]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_grad_fn(cfg_kwargs, batch, h, w, iters):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.training.loss import loss_mask, sequence_loss_fused
+
+    cfg = RAFTStereoConfig(**cfg_kwargs)
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32)
+    gt = jnp.asarray(rng.uniform(-8, 0, (batch, h, w, 1)), jnp.float32)
+    mask = loss_mask(gt, jnp.ones((batch, h, w), jnp.float32))
+    rest = {k: v for k, v in variables.items() if k != "params"}
+
+    def loss(p):
+        err, final = model.apply({"params": p, **rest}, img1, img2,
+                                 iters=iters, flow_gt=gt, loss_mask=mask)
+        return sequence_loss_fused(err, final, gt, mask)[0]
+
+    return jax.grad(loss), variables["params"]
+
+
+def profile_variant(name, cfg_kwargs, args, tel):
+    import jax
+
+    from raft_stereo_tpu.obs.xla import (conv_op_profile, emit_op_counts,
+                                         introspect_compiled)
+
+    grad_fn, params = build_grad_fn(cfg_kwargs, args.batch, args.h, args.w,
+                                    args.iters)
+    jaxpr = jax.make_jaxpr(grad_fn)(params)
+    profile = conv_op_profile(jaxpr)
+    rec = emit_op_counts(profile, tel, source=f"scan_wgrad_{name}",
+                         extra={"variant": name, "iters": args.iters})
+    out = {"variant": name, "op_profile": profile, **rec}
+    if not args.no_compile:
+        compiled = jax.jit(grad_fn).lower(params).compile()
+        xla = introspect_compiled(compiled, tel,
+                                  source=f"scan_wgrad_{name}",
+                                  extra={"variant": name})
+        out["memory"] = xla["memory"]
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--h", type=int, default=64)
+    p.add_argument("--w", type=int, default=96)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--residual_dtype", default=None,
+                   choices=[None, "bfloat16", "float32"])
+    p.add_argument("--save_policy", default=None,
+                   help="refinement_save_policy override (true/false/corr)")
+    p.add_argument("--no-compile", action="store_true",
+                   help="jaxpr profile only (skip the memory_analysis "
+                        "compile — the op-placement claim needs no XLA)")
+    p.add_argument("--out", default=os.path.join(REPO, "runs",
+                                                 "scan_grad_evidence"))
+    args = p.parse_args(argv)
+
+    from raft_stereo_tpu.obs import Telemetry
+    tel = Telemetry(args.out, stall_deadline_s=None)
+    tel.run_start(config=vars(args))
+
+    policy = {"true": True, "false": False, "corr": "corr"}.get(
+        str(args.save_policy).lower())
+    base = dict(refinement_save_policy=policy,
+                residual_dtype=args.residual_dtype)
+    results = [
+        profile_variant("autodiff", dict(base, batched_scan_wgrad=False),
+                        args, tel),
+        profile_variant("batched_wgrad", dict(base, batched_scan_wgrad=True),
+                        args, tel),
+    ]
+    tel.emit("run_end", steps=0, ok=True)
+    tel.close()
+
+    # The headline comparison: per-step convs of the LAST scan (the
+    # backward/reverse scan in both variants) and the outside-scan count.
+    def last_scan(r):
+        scans = r["op_profile"]["scans"]
+        return scans[-1]["convs_per_step"] if scans else 0
+
+    summary = {
+        "shape": [args.batch, args.h, args.w], "iters": args.iters,
+        "residual_dtype": args.residual_dtype,
+        "save_policy": args.save_policy,
+        "bwd_scan_convs_per_step": {r["variant"]: last_scan(r)
+                                    for r in results},
+        "convs_outside_scans": {r["variant"]:
+                                r["op_profile"]["outside_scans"]
+                                for r in results},
+        "peak_bytes": {r["variant"]:
+                       (r.get("memory") or {}).get("peak_bytes")
+                       for r in results},
+        "events": os.path.join(args.out, "events.jsonl"),
+    }
+    path = os.path.join(args.out, "summary.json")
+    with open(path, "w") as f:
+        json.dump({"summary": summary, "variants": results}, f, indent=1)
+    print(json.dumps(summary, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

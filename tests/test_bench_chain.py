@@ -141,21 +141,31 @@ def test_deadline_stops_chain_but_keeps_best():
 def test_real_chain_shape():
     """The production TPU chain: primary first with a tight timeout, the
     below-par-gated banker second (it must run even when a slow primary
-    banked a number), then unbanked fallbacks only."""
+    banked a number), the always-run scan-backward A/B third (r8 — banks
+    whichever refinement backward is faster, with the banker as the
+    pinned-off control), then unbanked fallbacks only."""
     chain = bench._attempt_chain(True)
     assert chain[0]["when"] == "always" and chain[0]["timeout_s"]
     assert chain[1]["when"] == "below_par"
     assert chain[1]["kw"]["remat_encoders"] == "blocks_hires"
+    # the scan custom-VJP A/B: always runs, banker schedule, lean stacks
+    assert chain[2]["when"] == "always"
+    assert chain[2]["kw"]["batched_scan_wgrad"] is True
+    assert chain[2]["kw"]["residual_dtype"] == "bfloat16"
+    assert chain[2]["kw"]["remat_encoders"] == "blocks_hires"
+    # the control (banker) must run BEFORE the A/B so a custom-path
+    # regression can never leave the round without the autodiff number
+    assert not chain[1]["kw"].get("batched_scan_wgrad")
     # the proven full blocks-remat config backs up the banker, below-par
     # gated too (it must get its shot if the banker banks low or fails)
-    assert chain[2]["when"] == "below_par"
-    assert chain[2]["kw"]["remat_encoders"] == "blocks"
-    # the r4-measured best schedule is on the primary and both bankers
-    for att in chain[:3]:
+    assert chain[3]["when"] == "below_par"
+    assert chain[3]["kw"]["remat_encoders"] == "blocks"
+    # the r4-measured best schedule is on the primary, bankers, and A/B
+    for att in chain[:4]:
         assert att["kw"]["remat_loss_tail"] is False
         assert att["kw"]["fold_enc_saves"] is False
         assert att["kw"]["upsample_tile_budget"] > 10 ** 9
-    assert all(a["when"] == "unbanked" for a in chain[3:])
+    assert all(a["when"] == "unbanked" for a in chain[4:])
     # the split-step attempt is gone (helper-rejected at b8 in r3 AND r4)
     assert not any(a["kw"].get("split_step") for a in chain)
     # every attempt is the SceneFlow recipe family
