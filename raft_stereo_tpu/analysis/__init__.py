@@ -1,0 +1,24 @@
+"""graftlint — static analysis over the lowered graph and the source tree.
+
+Two engines, one report format (findings.py):
+
+* graph_rules.py — declarative contract rules over the canonical train
+  step and inference lowerings (jaxpr + compiled artifact): op placement
+  inside the refinement scan's backward body (``wgrad-in-loop``), dtype
+  policy (``dtype-drift``, ``residual-dtype-conformance``), host sync,
+  donation aliasing, scan carry size, folded-constant size.
+* ast_rules.py — tracer-safety lint over the package source:
+  concretizing calls and wall-clock reads in jit-reachable functions,
+  module-import-time ``jnp`` work, argparse <-> config drift.
+
+Entry point: ``python -m raft_stereo_tpu.cli lint`` (runner.py) — exits
+non-zero on unsuppressed error-severity findings; ``.graftlint.json`` at
+the repo root is the checked-in suppression baseline.
+"""
+
+from raft_stereo_tpu.analysis.findings import (Finding, apply_baseline,
+                                               load_baseline, make_report,
+                                               severity_counts)
+
+__all__ = ["Finding", "apply_baseline", "load_baseline", "make_report",
+           "severity_counts"]

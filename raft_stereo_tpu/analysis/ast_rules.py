@@ -1,0 +1,496 @@
+"""Tracer-safety AST lint over the package source.
+
+The graph rules (graph_rules.py) check what the lowered artifact *is*; this
+engine checks what the source *would do under tracing* — the class of bug
+that doesn't change the jaxpr but breaks or silently de-optimizes it:
+
+* ``tracer-unsafe`` — ``float()``/``int()``/``bool()``/``.item()``/
+  ``np.asarray``/``np.array`` applied to values inside jit-reachable
+  functions. Under tracing these either raise ``ConcretizationTypeError``
+  or silently force a device sync. Static shape arithmetic is exempt:
+  names bound from ``.shape`` unpacking, ``len(...)``, ``.ndim`` (shapes
+  are python ints under jit) don't trip the rule.
+* ``wall-clock`` — ``time.time()``/``perf_counter()`` and friends inside
+  jit-reachable code measure *trace* time once, then become constants.
+* ``import-time-jnp`` — module-level ``jnp.*`` calls run device work (and
+  initialize the backend) at import, before the entry point can pick a
+  platform.
+* ``cli-drift`` — the argparse flag surface in cli.py vs the config.py
+  dataclasses: a constructor keyword that isn't a real field, a declared
+  flag that no config constructor consumes, and (info) config fields with
+  no flag exposure.
+
+Jit-reachability is a per-module static heuristic, not a call graph: a
+function is reachable when it is (a) referenced by name in a call to a
+tracing transform (``jax.jit``/``grad``/``vmap``/``lax.scan``/
+``nn.scan``/``pallas_call``/``custom_vjp`` & co., including through
+``functools.partial``), (b) decorated by one, (c) defined *inside* a
+reachable function, or (d) a method of a ``nn.Module`` subclass (flax
+methods are always traced). Helpers merely *called* from traced code are
+not chased — that keeps the lint fast and the false-positive rate near
+zero; the suppression baseline absorbs the remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from raft_stereo_tpu.analysis.findings import Finding
+
+# Call names (last attribute segment) that trace their function arguments.
+TRACING_TRANSFORMS = frozenset({
+    "jit", "pmap", "grad", "value_and_grad", "vmap", "checkpoint", "remat",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "map",
+    "custom_vjp", "custom_jvp", "defvjp", "defjvp", "pallas_call",
+    "shard_map", "eval_shape", "make_jaxpr", "named_call",
+})
+
+# Module aliases whose call results are host-side numpy, not tracers.
+NUMPY_NAMES = frozenset({"np", "numpy", "onp"})
+
+TRACER_UNSAFE_CASTS = frozenset({"float", "int", "bool"})
+
+# Names whose attribute reads are static at trace time: config dataclasses
+# and flax hyperparameters (`self.*` on a Module) are python values, not
+# tracers — `bool(cfg.fold_enc_saves)` is mode selection, not
+# concretization. Traced values always arrive as call arguments.
+STATIC_ROOTS = frozenset({"cfg", "config", "self"})
+WALL_CLOCK_CALLS = frozenset({
+    ("time", "time"), ("time", "perf_counter"), ("time", "monotonic"),
+    ("time", "process_time"), ("time", "time_ns"),
+    ("time", "perf_counter_ns"), ("datetime", "now"), ("datetime", "utcnow"),
+})
+
+
+def _last_attr(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    """``a.b.c`` -> ["a", "b", "c"]; empty when not a name/attr chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _called_functions(call: ast.Call) -> List[str]:
+    """Names of functions passed (positionally or by keyword) to a tracing
+    transform, unwrapping ``functools.partial(fn, ...)``."""
+    out: List[str] = []
+
+    def visit(arg):
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            chain = _attr_chain(arg)
+            if chain:
+                out.append(chain[-1])
+        elif isinstance(arg, ast.Call) and _last_attr(arg.func) == "partial":
+            for a in arg.args:
+                visit(a)
+
+    for a in call.args:
+        visit(a)
+    for kw in call.keywords:
+        if kw.arg in ("f", "fun", "fn", "body_fun", "cond_fun", "kernel"):
+            visit(kw.value)
+    # method-style: fwd.defvjp(fwd_rule, bwd_rule) — the receiver is
+    # reachable too
+    chain = _attr_chain(call.func)
+    if chain and chain[-1] in ("defvjp", "defjvp") and len(chain) >= 2:
+        out.append(chain[-2])
+    return out
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """One pass over a module: function defs (with qualnames), nn.Module
+    classes, names referenced by tracing transforms, jit-ish decorators."""
+
+    def __init__(self):
+        self.functions: Dict[str, List[ast.AST]] = {}   # name -> def nodes
+        self.qualname: Dict[int, str] = {}              # id(node) -> qual
+        self.parent_fn: Dict[int, Optional[ast.AST]] = {}
+        self.module_classes: Set[str] = set()           # nn.Module classes
+        self.traced_names: Set[str] = set()
+        self.decorated: Set[int] = set()                # id(def) jit-deco
+        self.module_level_stmts: List[ast.stmt] = []
+        self._stack: List[ast.AST] = []
+        self._class_stack: List[ast.ClassDef] = []
+
+    def visit_Module(self, node):
+        self.module_level_stmts = list(node.body)
+        self.generic_visit(node)
+
+    def _qual(self, name: str) -> str:
+        parts = [n.name for n in self._stack if hasattr(n, "name")]
+        return ".".join([c.name for c in self._class_stack] + parts + [name])
+
+    def visit_ClassDef(self, node):
+        for base in node.bases:
+            chain = _attr_chain(base)
+            if chain and chain[-1] == "Module":
+                self.module_classes.add(node.name)
+        self._class_stack.append(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_def(self, node):
+        self.functions.setdefault(node.name, []).append(node)
+        self.qualname[id(node)] = self._qual(node.name)
+        self.parent_fn[id(node)] = self._stack[-1] if self._stack else None
+        for deco in node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            name = _last_attr(target)
+            if name in TRACING_TRANSFORMS or name == "compact":
+                self.decorated.add(id(node))
+            if isinstance(deco, ast.Call) \
+                    and _last_attr(deco.func) == "partial":
+                for a in deco.args:
+                    if _last_attr(a) in TRACING_TRANSFORMS:
+                        self.decorated.add(id(node))
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Call(self, node):
+        name = _last_attr(node.func)
+        if name in TRACING_TRANSFORMS:
+            self.traced_names.update(_called_functions(node))
+        self.generic_visit(node)
+
+
+def _reachable_defs(index: _ModuleIndex) -> Dict[int, str]:
+    """id(def node) -> qualname for every jit-reachable function."""
+    reachable: Dict[int, str] = {}
+    # seeds: referenced in a transform call, decorated, or nn.Module method
+    for name in index.traced_names:
+        for node in index.functions.get(name, ()):
+            reachable[id(node)] = index.qualname[id(node)]
+    for name, nodes in index.functions.items():
+        for node in nodes:
+            if id(node) in index.decorated:
+                reachable[id(node)] = index.qualname[id(node)]
+            qual = index.qualname[id(node)]
+            cls = qual.split(".")[0] if "." in qual else None
+            if cls in index.module_classes:
+                reachable[id(node)] = qual
+    # closure: nested defs of reachable functions
+    changed = True
+    while changed:
+        changed = False
+        for name, nodes in index.functions.items():
+            for node in nodes:
+                if id(node) in reachable:
+                    continue
+                parent = index.parent_fn.get(id(node))
+                if parent is not None and id(parent) in reachable:
+                    reachable[id(node)] = index.qualname[id(node)]
+                    changed = True
+    return reachable
+
+
+# --- per-function checks -----------------------------------------------------
+
+def _shape_derived_names(fn: ast.AST) -> Set[str]:
+    """Names bound (anywhere in the function) from shape-like expressions:
+    ``b, h, w, c = x.shape``, ``n = x.shape[0]``, ``k = len(xs)``,
+    ``r = x.ndim`` — static python ints under tracing."""
+    names: Set[str] = set()
+
+    def shape_like(expr) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) and sub.attr in ("shape",
+                                                               "ndim"):
+                return True
+            if isinstance(sub, ast.Call) and _last_attr(sub.func) == "len":
+                return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and shape_like(node.value):
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+    return names
+
+
+def _names_in(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _is_static_arg(expr: ast.AST, static_names: Set[str],
+                   neutral_names: Set[str]) -> bool:
+    """True when every name feeding the expression is statically known
+    (shape-derived or a module alias) or the expression itself reads
+    ``.shape``/``.ndim``/``len``."""
+    if isinstance(expr, ast.Constant):
+        return True
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim"):
+            return True
+        if isinstance(sub, ast.Call) and _last_attr(sub.func) == "len":
+            return True
+    return _names_in(expr) <= (static_names | neutral_names | STATIC_ROOTS)
+
+
+def check_function(fn: ast.AST, relpath: str, qual: str,
+                   neutral_names: Set[str]) -> List[Finding]:
+    """tracer-unsafe + wall-clock findings for one jit-reachable function
+    (``fn``'s own body only — nested defs are visited separately)."""
+    findings: List[Finding] = []
+    static_names = _shape_derived_names(fn)
+
+    skip: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+
+    loc = f"{relpath}::{qual}"
+    for node in ast.walk(fn):
+        if id(node) in skip or not isinstance(node, ast.Call):
+            continue
+        name = _last_attr(node.func)
+        chain = _attr_chain(node.func)
+        # float()/int()/bool() on a traced value
+        if isinstance(node.func, ast.Name) \
+                and name in TRACER_UNSAFE_CASTS and node.args:
+            if not _is_static_arg(node.args[0], static_names, neutral_names):
+                findings.append(Finding(
+                    rule="tracer-unsafe", severity="error", location=loc,
+                    message=f"`{name}()` on a value inside a jit-reachable "
+                            f"function forces concretization "
+                            f"(line {node.lineno})",
+                    data={"call": name, "line": node.lineno}))
+        # .item()
+        elif name == "item" and isinstance(node.func, ast.Attribute) \
+                and not node.args:
+            findings.append(Finding(
+                rule="tracer-unsafe", severity="error", location=loc,
+                message=f"`.item()` inside a jit-reachable function "
+                        f"(line {node.lineno})",
+                data={"call": "item", "line": node.lineno}))
+        # np.asarray / np.array on a traced value
+        elif name in ("asarray", "array") and len(chain) >= 2 \
+                and chain[-2] in NUMPY_NAMES and node.args:
+            if not _is_static_arg(node.args[0], static_names, neutral_names):
+                findings.append(Finding(
+                    rule="tracer-unsafe", severity="error", location=loc,
+                    message=f"`{'.'.join(chain)}` materializes a host array "
+                            f"inside a jit-reachable function "
+                            f"(line {node.lineno})",
+                    data={"call": ".".join(chain), "line": node.lineno}))
+        # wall clock
+        if len(chain) >= 2 and (chain[-2], chain[-1]) in WALL_CLOCK_CALLS:
+            findings.append(Finding(
+                rule="wall-clock", severity="error", location=loc,
+                message=f"`{'.'.join(chain)}` inside a jit-reachable "
+                        f"function is evaluated once at trace time "
+                        f"(line {node.lineno})",
+                data={"call": ".".join(chain), "line": node.lineno}))
+    return findings
+
+
+# --- module-level jnp work ---------------------------------------------------
+
+def _jnp_aliases(tree: ast.Module) -> Set[str]:
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy":
+                    aliases.add(a.asname or "jax")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+    return aliases
+
+
+def check_import_time_jnp(tree: ast.Module, relpath: str) -> List[Finding]:
+    """Module-level ``jnp.*``/``jax.numpy.*`` calls (device work + backend
+    init at import). Defs/classes don't execute at import; guarded blocks
+    (``if __name__``, ``TYPE_CHECKING``) are left alone."""
+    aliases = _jnp_aliases(tree)
+    findings: List[Finding] = []
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom,
+                             ast.If)):
+            continue
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            hit = (chain and chain[0] in aliases) \
+                or (len(chain) >= 2 and chain[0] == "jax"
+                    and chain[1] == "numpy")
+            if hit:
+                findings.append(Finding(
+                    rule="import-time-jnp", severity="error",
+                    location=f"{relpath}::<module>",
+                    message=f"`{'.'.join(chain)}(...)` runs at import time "
+                            f"(line {node.lineno}): device work before the "
+                            f"entry point can pick a platform",
+                    data={"call": ".".join(chain), "line": node.lineno}))
+    return findings
+
+
+# --- cli.py <-> config.py drift ----------------------------------------------
+
+def _argparse_dests(fn: ast.AST) -> Set[str]:
+    dests: Set[str] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and _last_attr(node.func) == "add_argument"):
+            continue
+        for a in node.args:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                    and a.value.startswith("--"):
+                dests.add(a.value.lstrip("-").replace("-", "_"))
+    return dests
+
+
+def _consumed_and_kwargs(fn: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(args.<x> / getattr(args, "x") reads, config-constructor keywords)
+    in one ``*_config`` builder."""
+    consumed: Set[str] = set()
+    kwargs: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "args":
+            consumed.add(node.attr)
+        if isinstance(node, ast.Call) \
+                and _last_attr(node.func) == "getattr" and node.args:
+            if isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id == "args" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant):
+                consumed.add(node.args[1].value)
+        if isinstance(node, ast.Call) \
+                and _last_attr(node.func) in ("RAFTStereoConfig",
+                                              "TrainConfig"):
+            kwargs.update(kw.arg for kw in node.keywords
+                          if kw.arg is not None)
+    return consumed, kwargs
+
+
+def check_cli_config_drift(cli_path: str, relpath: str) -> List[Finding]:
+    """The flag surface is the public API; the dataclasses are the
+    implementation. Three drift modes: a constructor keyword naming a
+    non-existent field (typo — would only explode at runtime), a declared
+    flag that the matching ``*_config`` builder never reads (parsed then
+    silently dropped), and — informational — config fields with no flag."""
+    import dataclasses as dc
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+
+    with open(cli_path) as f:
+        tree = ast.parse(f.read(), filename=cli_path)
+    fns = {n.name: n for n in tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    findings: List[Finding] = []
+    pairs = [("add_model_args", "model_config", RAFTStereoConfig),
+             ("add_train_args", "train_config", TrainConfig)]
+    for add_fn, cfg_fn, cls in pairs:
+        if add_fn not in fns or cfg_fn not in fns:
+            continue
+        fields = {f.name for f in dc.fields(cls)}
+        dests = _argparse_dests(fns[add_fn])
+        consumed, kwargs = _consumed_and_kwargs(fns[cfg_fn])
+        for kw in sorted(kwargs - fields):
+            findings.append(Finding(
+                rule="cli-drift", severity="error",
+                location=f"{relpath}::{cfg_fn}",
+                message=f"{cfg_fn}() passes keyword {kw!r} but "
+                        f"{cls.__name__} has no such field",
+                data={"keyword": kw}))
+        for d in sorted(dests - consumed):
+            findings.append(Finding(
+                rule="cli-drift", severity="error",
+                location=f"{relpath}::{add_fn}",
+                message=f"flag --{d} is declared in {add_fn}() but "
+                        f"{cfg_fn}() never reads args.{d} — parsed then "
+                        f"dropped",
+                data={"dest": d}))
+        unexposed = sorted(fields - kwargs)
+        if unexposed:
+            findings.append(Finding(
+                rule="cli-drift", severity="info",
+                location=f"{relpath}::{cfg_fn}",
+                message=f"{len(unexposed)} {cls.__name__} field(s) not "
+                        f"settable from the CLI: {', '.join(unexposed)}",
+                data={"fields": unexposed}))
+    return findings
+
+
+# --- engine ------------------------------------------------------------------
+
+def lint_source(text: str, relpath: str) -> List[Finding]:
+    """All per-module AST rules over one file's source."""
+    tree = ast.parse(text, filename=relpath)
+    index = _ModuleIndex()
+    index.visit(tree)
+    # module aliases are neutral in static-arg analysis (math.sqrt(d) etc.)
+    neutral: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            neutral.update((a.asname or a.name).split(".")[0]
+                           for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            neutral.update(a.asname or a.name for a in node.names)
+    findings = check_import_time_jnp(tree, relpath)
+    defs_by_id = {id(n): n for nodes in index.functions.values()
+                  for n in nodes}
+    for fn_id, qual in sorted(_reachable_defs(index).items(),
+                              key=lambda kv: kv[1]):
+        findings.extend(check_function(defs_by_id[fn_id], relpath, qual,
+                                       neutral))
+    return findings
+
+
+def run_ast_rules(package_root: str,
+                  repo_root: Optional[str] = None) -> List[Finding]:
+    """Lint every module under ``package_root`` + the cli/config drift
+    check. Locations are repo-relative."""
+    repo_root = repo_root or os.path.dirname(package_root)
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            relpath = os.path.relpath(path, repo_root)
+            with open(path) as f:
+                text = f.read()
+            try:
+                findings.extend(lint_source(text, relpath))
+            except SyntaxError as e:
+                findings.append(Finding(
+                    rule="tracer-unsafe", severity="error",
+                    location=relpath,
+                    message=f"unparseable module: {e}", data={}))
+    cli_path = os.path.join(package_root, "cli.py")
+    if os.path.exists(cli_path):
+        findings.extend(check_cli_config_drift(
+            cli_path, os.path.relpath(cli_path, repo_root)))
+    return findings

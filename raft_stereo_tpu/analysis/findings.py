@@ -1,0 +1,157 @@
+"""Finding record, JSON report and suppression baseline — graftlint's spine.
+
+Both engines (graph_rules.py over lowered jaxprs/compiled artifacts,
+ast_rules.py over the package source) emit the same record: a rule id, a
+severity, a *line-stable* location, a human message and a machine ``data``
+payload. The runner merges them, applies the checked-in suppression
+baseline (``.graftlint.json`` at the repo root), renders the report and
+gates on unsuppressed error-severity findings.
+
+Locations are deliberately line-free (``path::qualname`` for AST findings,
+``target/scan[i]``-style for graph findings; line numbers ride in
+``data``): a baseline keyed on line numbers would rot on every unrelated
+edit, which is how suppression files turn into noise generators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = ".graftlint.json"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation (or observation, at info severity)."""
+
+    rule: str
+    severity: str
+    location: str
+    message: str
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: set by :func:`apply_baseline` when a suppression matches
+    suppressed: bool = False
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in {SEVERITIES}")
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        """The identity a suppression matches on."""
+        return (self.rule, self.location)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"rule": self.rule, "severity": self.severity,
+               "location": self.location, "message": self.message}
+        if self.data:
+            out["data"] = self.data
+        if self.suppressed:
+            out["suppressed"] = True
+        return out
+
+
+# --- suppression baseline ----------------------------------------------------
+
+def load_baseline(path: str) -> List[Dict[str, Any]]:
+    """Read ``.graftlint.json``; a missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: baseline version {doc.get('version')!r} "
+                         f"!= {BASELINE_VERSION}")
+    entries = doc.get("suppressions", [])
+    for e in entries:
+        if not isinstance(e, dict) or "rule" not in e or "location" not in e:
+            raise ValueError(f"{path}: suppression entries need "
+                             f"'rule' and 'location': {e!r}")
+    return entries
+
+
+def apply_baseline(findings: Iterable[Finding],
+                   suppressions: List[Dict[str, Any]]
+                   ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
+    """Mark findings matched by the baseline; return (findings, stale).
+
+    ``stale`` is the suppressions that matched nothing — a fixed violation
+    whose baseline entry should be deleted (reported, never fatal: a stale
+    entry must not block the gate the way a real finding does).
+    """
+    findings = list(findings)
+    used = set()
+    by_key = {(e["rule"], e["location"]): i
+              for i, e in enumerate(suppressions)}
+    for f in findings:
+        idx = by_key.get(f.key)
+        if idx is not None:
+            f.suppressed = True
+            used.add(idx)
+    stale = [e for i, e in enumerate(suppressions) if i not in used]
+    return findings, stale
+
+
+def baseline_from_findings(findings: Iterable[Finding],
+                           reason: str = "baselined pre-existing finding"
+                           ) -> Dict[str, Any]:
+    """Serialize current unsuppressed findings as a fresh baseline doc
+    (the ``--update-baseline`` round-trip)."""
+    seen = set()
+    entries = []
+    for f in findings:
+        if f.suppressed or f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append({"rule": f.rule, "location": f.location,
+                        "reason": reason, "severity": f.severity})
+    return {"version": BASELINE_VERSION, "suppressions": entries}
+
+
+def write_baseline(path: str, doc: Dict[str, Any]) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+# --- report ------------------------------------------------------------------
+
+def severity_counts(findings: Iterable[Finding],
+                    suppressed: Optional[bool] = None) -> Dict[str, int]:
+    """Count findings per severity; ``suppressed`` filters when not None."""
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        if suppressed is None or f.suppressed == suppressed:
+            counts[f.severity] += 1
+    return counts
+
+
+def make_report(findings: List[Finding], rules_run: List[str],
+                engines: List[str],
+                stale_suppressions: Optional[List[Dict[str, Any]]] = None
+                ) -> Dict[str, Any]:
+    """The JSON report ``cli lint --json`` writes: per-finding detail plus
+    the summary the ``lint`` event mirrors."""
+    return {
+        "report": "graftlint",
+        "version": 1,
+        "engines": engines,
+        "rules_run": sorted(rules_run),
+        "counts": severity_counts(findings),
+        "unsuppressed": severity_counts(findings, suppressed=False),
+        "suppressed_total": sum(1 for f in findings if f.suppressed),
+        "stale_suppressions": stale_suppressions or [],
+        "findings": [f.to_dict() for f in findings],
+    }
+
+
+def gate(findings: Iterable[Finding]) -> int:
+    """Exit status: 1 when any unsuppressed error-severity finding remains."""
+    return 1 if any(f.severity == "error" and not f.suppressed
+                    for f in findings) else 0

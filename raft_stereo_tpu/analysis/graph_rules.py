@@ -1,0 +1,525 @@
+"""Jaxpr/compiled-artifact contract rules over the canonical step functions.
+
+The performance story rests on structural invariants of the lowered graph —
+*which ops live inside the refinement scan body* sets the serial floor
+(RAFT's recurrent loop, arXiv 2003.12039; the recurrent-backward placement
+question formalized in arXiv 1709.04057), dtype policy decides the stack
+residency the r7 breakdown named dominant, and donation/host-sync hazards
+silently cost a copy of the train state or a device round-trip per step.
+Until now each invariant was policed by one hand-written test or a comment;
+this module makes them declarative rules over two canonical lowerings:
+
+* ``train_step`` — grad of the fused-loss step at a tiny CPU shape
+  (autodiff backward; compiled donated, like bench.py's and the DP path's
+  ``donate_argnums=(0,)``), plus a ``train_step[batched]`` variant with the
+  custom-VJP scan + bf16 residuals engaged and its autodiff twin traced for
+  comparison;
+* ``inference`` — the ``test_mode`` forward ``StereoPredictor`` jits.
+
+Same jaxpr topology as the real shapes (shape enters only aval sizes), so
+every placement/dtype/callback contract checked here holds for the TPU
+executable. Each rule returns :class:`~.findings.Finding`s; the runner
+(analysis/runner.py) merges them with the AST lint and gates on errors.
+
+Rule ids: ``wgrad-in-loop``, ``dtype-drift``,
+``residual-dtype-conformance``, ``host-sync``, ``donation``,
+``carry-growth``, ``constant-bloat``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from raft_stereo_tpu.analysis.findings import Finding
+
+# Thresholds a caller (or a fixture test) can override per run.
+DEFAULT_THRESHOLDS: Dict[str, int] = {
+    # scan carry resident per backward iteration — warn past this
+    "carry_bytes": 1 << 30,          # 1 GiB
+    # one constant folded into the executable — warn past this
+    "const_bytes": 2 << 20,          # 2 MiB
+    # undonated argument buffers on a target that declares no donation
+    "nondonated_arg_bytes": 512 << 20,
+    # a convert round-trip on arrays at or below this many elements is
+    # scalar glue, not a bandwidth hazard
+    "roundtrip_min_elems": 2,
+    # the wgrad-in-loop contract (mirrors tests/test_scan_grad.py's pin):
+    # >= hoisted_min wgrad convs leave the backward body, and the same
+    # count appears outside as batched contractions; slack covers the
+    # replay ops the custom path adds back into the body
+    "wgrad_hoisted_min": 6,
+    "wgrad_body_slack": 3,
+}
+
+
+@dataclasses.dataclass
+class GraphTarget:
+    """One lowered artifact under analysis."""
+
+    name: str
+    cfg: Any                      # RAFTStereoConfig
+    closed_jaxpr: Any             # jax.core.ClosedJaxpr
+    compiled: Any = None          # jax.stages.Compiled, when compiled
+    donate_declared: bool = False
+    platform: str = "cpu"
+    #: comparison lowerings, e.g. {"autodiff": ClosedJaxpr} on the batched
+    #: train variant (the wgrad rule diffs placement against it)
+    variants: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        return int(aval.size) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _walk(target):
+    from raft_stereo_tpu.obs.xla import iter_eqns
+    return iter_eqns(target.closed_jaxpr, path=target.name)
+
+
+# --- rule: wgrad-in-loop -----------------------------------------------------
+
+def check_wgrad_hoisting(profile_autodiff: Dict[str, Any],
+                         profile_batched: Dict[str, Any],
+                         hoisted_min: int = 6, body_slack: int = 3,
+                         location: str = "train_step[batched]"
+                         ) -> List[Finding]:
+    """The shared form of tests/test_scan_grad.py's op-placement pin.
+
+    Inputs are two :func:`~raft_stereo_tpu.obs.xla.conv_op_profile` results
+    (autodiff vs batched lowering of the SAME step). Contract: the batched
+    path's backward scan body (the last scan in jaxpr order for a grad
+    lowering) runs at least ``hoisted_min`` fewer convs per iteration
+    (minus ``body_slack`` for the replay ops it adds), and at least
+    ``hoisted_min`` batched contractions appear outside any scan. Both the
+    lint rule and the test assert through this function, so they cannot
+    drift apart."""
+    findings: List[Finding] = []
+    if not profile_autodiff["scans"] or not profile_batched["scans"]:
+        return [Finding(
+            rule="wgrad-in-loop", severity="error", location=location,
+            message="no refinement scan found in one of the lowerings "
+                    "(profile has no scans) — the placement contract "
+                    "cannot hold",
+            data={"autodiff": profile_autodiff, "batched": profile_batched})]
+    bwd_auto = profile_autodiff["scans"][-1]["convs_per_step"]
+    bwd_cust = profile_batched["scans"][-1]["convs_per_step"]
+    out_auto = profile_autodiff["outside_scans"]
+    out_cust = profile_batched["outside_scans"]
+    data = {"backward_convs_per_step": {"autodiff": bwd_auto,
+                                        "batched": bwd_cust},
+            "outside_scan_convs": {"autodiff": out_auto,
+                                   "batched": out_cust},
+            "hoisted_min": hoisted_min, "body_slack": body_slack}
+    if bwd_cust > bwd_auto - hoisted_min + body_slack:
+        findings.append(Finding(
+            rule="wgrad-in-loop", severity="error",
+            location=f"{location}/backward-scan",
+            message=f"backward scan body still runs {bwd_cust} convs/step "
+                    f"(autodiff: {bwd_auto}) — the per-iteration weight-"
+                    f"grad convs were not hoisted out of the loop",
+            data=data))
+    if out_cust < out_auto + hoisted_min:
+        findings.append(Finding(
+            rule="wgrad-in-loop", severity="error",
+            location=f"{location}/outside-scans",
+            message=f"only {out_cust - out_auto} extra convs outside the "
+                    f"scans (expected >= {hoisted_min} batched wgrad "
+                    f"contractions)",
+            data=data))
+    return findings
+
+
+def rule_wgrad_in_loop(target: GraphTarget,
+                       thresholds: Dict[str, int]) -> List[Finding]:
+    """When ``batched_scan_wgrad`` is on, the weight-grad convs must be
+    out of the backward scan body (vs the autodiff twin lowering)."""
+    if not bool(target.cfg.batched_scan_wgrad):
+        return []
+    autodiff = target.variants.get("autodiff")
+    if autodiff is None:
+        return []
+    from raft_stereo_tpu.obs.xla import conv_op_profile
+    return check_wgrad_hoisting(
+        conv_op_profile(autodiff), conv_op_profile(target.closed_jaxpr),
+        hoisted_min=thresholds["wgrad_hoisted_min"],
+        body_slack=thresholds["wgrad_body_slack"], location=target.name)
+
+
+# --- rule: dtype-drift -------------------------------------------------------
+
+def rule_dtype_drift(target: GraphTarget,
+                     thresholds: Dict[str, int]) -> List[Finding]:
+    """fp32<->bf16 round-trip convert chains (a rounding pass that buys
+    nothing — storage narrowing pays for itself only across a scan/stack
+    boundary, which is not a direct chain) and any float64 op (silent 2x
+    memory and, on TPU, a catastrophic emulation path)."""
+    import numpy as np
+
+    findings: List[Finding] = []
+    f32, bf16 = np.dtype("float32"), np.dtype("bfloat16") if hasattr(
+        np, "bfloat16") else None
+    try:
+        import ml_dtypes
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+    except Exception:
+        pass
+    roundtrips: Dict[str, int] = {}
+    f64_ops: Dict[str, int] = {}
+    # Per (jaxpr path) producer map: var id -> producing convert eqn.
+    producers: Dict[int, Any] = {}
+    min_elems = thresholds["roundtrip_min_elems"]
+    for eqn, path in _walk(target):
+        for ov in eqn.outvars:
+            aval = getattr(ov, "aval", None)
+            if aval is not None and getattr(aval, "dtype", None) is not None \
+                    and aval.dtype == np.dtype("float64"):
+                f64_ops[path] = f64_ops.get(path, 0) + 1
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        (out,) = eqn.outvars
+        src = eqn.invars[0]
+        prev = producers.get(id(src))
+        if prev is not None:
+            prev_eqn, prev_path = prev
+            a = prev_eqn.invars[0].aval
+            b = out.aval
+            if (a.dtype == b.dtype and a.size >= min_elems
+                    and bf16 is not None
+                    and {a.dtype, src.aval.dtype} == {f32, bf16}):
+                roundtrips[path] = roundtrips.get(path, 0) + 1
+        producers[id(out)] = (eqn, path)
+    for path, n in sorted(roundtrips.items()):
+        findings.append(Finding(
+            rule="dtype-drift", severity="warning", location=path,
+            message=f"{n} fp32<->bf16 round-trip convert chain(s): a value "
+                    f"is narrowed and immediately widened back — pure "
+                    f"rounding, no storage or bandwidth win",
+            data={"count": n}))
+    for path, n in sorted(f64_ops.items()):
+        findings.append(Finding(
+            rule="dtype-drift", severity="error", location=path,
+            message=f"{n} float64-producing op(s) in a jitted graph "
+                    f"(accidental x64 promotion)",
+            data={"count": n}))
+    return findings
+
+
+# --- rule: residual-dtype-conformance ---------------------------------------
+
+def _scan_stacks(target) -> List[Tuple[str, Any]]:
+    """(scan path, ys aval) for every scan's stacked outputs, in walk
+    order; scans are indexed per nesting path so two sibling scans get
+    distinct locations."""
+    out = []
+    scan_i: Dict[str, int] = {}
+    for eqn, path in _walk(target):
+        if eqn.primitive.name != "scan":
+            continue
+        i = scan_i.get(path, 0)
+        scan_i[path] = i + 1
+        nc = eqn.params["num_carry"]
+        for ov in eqn.outvars[nc:]:
+            out.append((f"{path}/scan[{i}]", ov.aval))
+    return out
+
+
+def rule_residual_dtype(target: GraphTarget,
+                        thresholds: Dict[str, int]) -> List[Finding]:
+    """When ``residual_dtype`` is configured on the custom-VJP path, the
+    scan residual stacks must actually be stored in it — the failure mode
+    is the knob silently doing nothing (the dtype policy previously policed
+    by comments). Model outputs legitimately stacked in fp32 (the deferred
+    upsample's mask/flow stacks) are why this is a presence contract, not
+    an everything-narrowed contract; under the ``"corr"`` save policy the
+    corr-channel stack must exist in the storage dtype too."""
+    import numpy as np
+
+    cfg = target.cfg
+    if cfg.residual_dtype is None or not bool(cfg.batched_scan_wgrad):
+        return []
+    want = np.dtype(cfg.residual_dtype)
+    stacks = _scan_stacks(target)
+    conforming = [(p, a) for p, a in stacks if a.dtype == want]
+    by_dtype: Dict[str, int] = {}
+    for _, a in stacks:
+        by_dtype[str(a.dtype)] = by_dtype.get(str(a.dtype), 0) \
+            + _aval_bytes(a)
+    data = {"configured": str(want), "stack_bytes_by_dtype": by_dtype,
+            "n_stacks": len(stacks), "n_conforming": len(conforming)}
+    findings: List[Finding] = []
+    if not conforming:
+        findings.append(Finding(
+            rule="residual-dtype-conformance", severity="error",
+            location=target.name,
+            message=f"residual_dtype={cfg.residual_dtype!r} is configured "
+                    f"but no scan residual stack is stored in it — the "
+                    f"narrowing knob is dead in this lowering",
+            data=data))
+        return findings
+    # The custom path stacks residuals in BOTH directions: forward saves
+    # (carries/policy stacks) and the backward scan's wgrad input/cotangent
+    # stacks. Conformance on only one side means half the residency win
+    # silently evaporated.
+    scans_with_stacks = {p for p, _ in stacks}
+    scans_conforming = {p for p, _ in conforming}
+    if len(scans_with_stacks) >= 2 and len(scans_conforming) < 2:
+        findings.append(Finding(
+            rule="residual-dtype-conformance", severity="warning",
+            location=target.name,
+            message=f"residual stacks in {cfg.residual_dtype!r} appear in "
+                    f"only one scan — forward saves and backward wgrad "
+                    f"stacks should both be narrowed",
+            data=data))
+    if cfg.refinement_save_policy == "corr":
+        ch = cfg.corr_channels
+        if not any(a.shape and a.shape[-1] == ch and a.dtype == want
+                   for _, a in stacks):
+            findings.append(Finding(
+                rule="residual-dtype-conformance", severity="error",
+                location=target.name,
+                message=f"save policy 'corr' engaged but no "
+                        f"{ch}-channel stack in {cfg.residual_dtype!r} "
+                        f"found",
+                data=data))
+    return findings
+
+
+# --- rule: host-sync ---------------------------------------------------------
+
+HOST_SYNC_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "debug_print", "infeed", "outfeed", "host_callback_call",
+    "outside_call",
+})
+
+
+def rule_host_sync(target: GraphTarget,
+                   thresholds: Dict[str, int]) -> List[Finding]:
+    """Host callbacks / infeed / outfeed inside a jitted hot path force a
+    device<->host round trip per execution (and on tunneled TPUs, a tunnel
+    RTT) — never acceptable in the canonical step functions."""
+    hits: Dict[Tuple[str, str], int] = {}
+    for eqn, path in _walk(target):
+        if eqn.primitive.name in HOST_SYNC_PRIMITIVES:
+            key = (path, eqn.primitive.name)
+            hits[key] = hits.get(key, 0) + 1
+    return [Finding(
+        rule="host-sync", severity="error", location=path,
+        message=f"{n} `{prim}` op(s) inside the jitted graph — host sync "
+                f"in the hot path",
+        data={"primitive": prim, "count": n})
+        for (path, prim), n in sorted(hits.items())]
+
+
+# --- rule: donation ----------------------------------------------------------
+
+def rule_donation(target: GraphTarget,
+                  thresholds: Dict[str, int]) -> List[Finding]:
+    """Declared donations must materialize as input/output aliases in the
+    compiled executable (XLA drops donation silently when shapes/layouts
+    mismatch — the state then costs a second copy of itself); without any
+    donation, large argument buffers are flagged for review."""
+    if target.compiled is None:
+        return []
+    from raft_stereo_tpu.obs.xla import memory_analysis_dict
+    mem = memory_analysis_dict(target.compiled)
+    if mem is None:
+        return []
+    alias = mem.get("alias_bytes", 0)
+    args = mem.get("argument_bytes", 0)
+    findings: List[Finding] = []
+    if target.donate_declared and alias == 0:
+        findings.append(Finding(
+            rule="donation", severity="error", location=target.name,
+            message="donate_argnums declared but the compiled executable "
+                    "aliases 0 bytes — donation was dropped and the state "
+                    "is double-buffered",
+            data={"argument_bytes": args, "platform": target.platform}))
+    if not target.donate_declared \
+            and args > thresholds["nondonated_arg_bytes"]:
+        findings.append(Finding(
+            rule="donation", severity="info", location=target.name,
+            message=f"{args} argument bytes with no donation declared — "
+                    f"if any input is dead after the call, donating it "
+                    f"saves its residency",
+            data={"argument_bytes": args}))
+    return findings
+
+
+# --- rule: carry-growth ------------------------------------------------------
+
+def rule_carry_growth(target: GraphTarget,
+                      thresholds: Dict[str, int]) -> List[Finding]:
+    """A scan carry is resident for the whole loop; a carry past the
+    threshold (default 1 GiB) says something bulky (a param tree, a full
+    activation set) is riding the loop instead of living outside it."""
+    limit = thresholds["carry_bytes"]
+    findings: List[Finding] = []
+    scan_i: Dict[str, int] = {}
+    for eqn, path in _walk(target):
+        if eqn.primitive.name != "scan":
+            continue
+        i = scan_i.get(path, 0)
+        scan_i[path] = i + 1
+        nc = eqn.params["num_carry"]
+        num_consts = eqn.params.get("num_consts", 0)
+        carry_bytes = sum(_aval_bytes(v.aval)
+                          for v in eqn.invars[num_consts:num_consts + nc])
+        if carry_bytes > limit:
+            findings.append(Finding(
+                rule="carry-growth", severity="warning",
+                location=f"{path}/scan[{i}]",
+                message=f"scan carry is {carry_bytes} bytes "
+                        f"(> {limit}): resident every iteration of the "
+                        f"loop",
+                data={"carry_bytes": carry_bytes, "limit": limit,
+                      "length": int(eqn.params.get("length") or 0)}))
+    return findings
+
+
+# --- rule: constant-bloat ----------------------------------------------------
+
+def rule_constant_bloat(target: GraphTarget,
+                        thresholds: Dict[str, int]) -> List[Finding]:
+    """Constants folded into the jaxpr ship inside every executable (and
+    the compilation cache); one past the threshold usually means an array
+    was closed over instead of passed as an argument."""
+    import numpy as np
+
+    limit = thresholds["const_bytes"]
+    findings: List[Finding] = []
+    consts = getattr(target.closed_jaxpr, "consts", ()) or ()
+    total = 0
+    for i, c in enumerate(consts):
+        try:
+            arr = np.asarray(c)
+        except Exception:
+            continue
+        nbytes = int(arr.size) * arr.dtype.itemsize
+        total += nbytes
+        if nbytes > limit:
+            findings.append(Finding(
+                rule="constant-bloat", severity="warning",
+                location=f"{target.name}/const[{i}]",
+                message=f"constant of {nbytes} bytes (> {limit}) folded "
+                        f"into the lowering (shape {tuple(arr.shape)}, "
+                        f"{arr.dtype})",
+                data={"const_bytes": nbytes, "limit": limit,
+                      "shape": list(arr.shape), "dtype": str(arr.dtype)}))
+    return findings
+
+
+GRAPH_RULES: Dict[str, Callable[[GraphTarget, Dict[str, int]],
+                                List[Finding]]] = {
+    "wgrad-in-loop": rule_wgrad_in_loop,
+    "dtype-drift": rule_dtype_drift,
+    "residual-dtype-conformance": rule_residual_dtype,
+    "host-sync": rule_host_sync,
+    "donation": rule_donation,
+    "carry-growth": rule_carry_growth,
+    "constant-bloat": rule_constant_bloat,
+}
+
+
+def run_rules_on_target(target: GraphTarget,
+                        thresholds: Optional[Dict[str, int]] = None
+                        ) -> List[Finding]:
+    th = dict(DEFAULT_THRESHOLDS, **(thresholds or {}))
+    findings: List[Finding] = []
+    for fn in GRAPH_RULES.values():
+        findings.extend(fn(target, th))
+    return findings
+
+
+# --- canonical targets -------------------------------------------------------
+
+def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
+                  compile_train: bool = True) -> List[GraphTarget]:
+    """Lower the canonical step functions at a tiny shape (same topology as
+    the production shapes — only aval sizes differ).
+
+    Three targets: the default autodiff ``train_step`` (compiled with
+    ``donate_argnums=(0,)`` like bench.py / the DP path — the donation rule
+    needs the executable), ``train_step[batched]`` (custom-VJP scan + bf16
+    residual stacks, jaxpr-only, with its autodiff twin attached for the
+    wgrad placement diff), and the ``test_mode`` ``inference`` forward.
+    One model init is shared: the variant configs differ only in backward
+    scheduling, never in parameters."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.models import create_model, init_model
+    from raft_stereo_tpu.training.loss import loss_mask, sequence_loss_fused
+
+    base = RAFTStereoConfig()
+    model, variables = init_model(jax.random.PRNGKey(0), base,
+                                  (1, h, w, 3))
+    rng = np.random.default_rng(0)
+    img1 = jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32)
+    img2 = jnp.asarray(rng.uniform(0, 255, (batch, h, w, 3)), jnp.float32)
+    gt = jnp.asarray(rng.uniform(-8, 0, (batch, h, w, 1)), jnp.float32)
+    mask = loss_mask(gt, jnp.ones((batch, h, w), jnp.float32))
+    rest = {k: v for k, v in variables.items() if k != "params"}
+    platform = jax.default_backend()
+
+    def grad_fn(cfg):
+        m = create_model(cfg)
+
+        def loss(p):
+            err, final = m.apply({"params": p, **rest}, img1, img2,
+                                 iters=iters, flow_gt=gt, loss_mask=mask)
+            return sequence_loss_fused(err, final, gt, mask)[0]
+
+        return jax.grad(loss)
+
+    params = variables["params"]
+    targets: List[GraphTarget] = []
+
+    # 1) default autodiff train step, donated compile
+    g = grad_fn(base)
+    compiled = None
+    if compile_train:
+        compiled = jax.jit(g, donate_argnums=(0,)).lower(params).compile()
+    targets.append(GraphTarget(
+        name="train_step", cfg=base, closed_jaxpr=jax.make_jaxpr(g)(params),
+        compiled=compiled, donate_declared=True, platform=platform))
+
+    # 2) batched custom-VJP train step with bf16 residual stacks + twin
+    cfg_b = dataclasses.replace(base, batched_scan_wgrad=True,
+                                refinement_save_policy=False,
+                                residual_dtype="bfloat16")
+    cfg_a = dataclasses.replace(base, refinement_save_policy=False)
+    targets.append(GraphTarget(
+        name="train_step[batched]", cfg=cfg_b,
+        closed_jaxpr=jax.make_jaxpr(grad_fn(cfg_b))(params),
+        platform=platform,
+        variants={"autodiff": jax.make_jaxpr(grad_fn(cfg_a))(params)}))
+
+    # 3) inference forward (what StereoPredictor jits)
+    def infer(v, a, b):
+        return model.apply(v, a, b, iters=iters, test_mode=True)
+
+    targets.append(GraphTarget(
+        name="inference", cfg=base,
+        closed_jaxpr=jax.make_jaxpr(infer)(variables, img1, img2),
+        platform=platform))
+    return targets
+
+
+def run_graph_rules(thresholds: Optional[Dict[str, int]] = None,
+                    compile_train: bool = True,
+                    targets: Optional[List[GraphTarget]] = None
+                    ) -> List[Finding]:
+    """Build the canonical targets (unless given) and run every rule."""
+    if targets is None:
+        targets = build_targets(compile_train=compile_train)
+    findings: List[Finding] = []
+    for t in targets:
+        findings.extend(run_rules_on_target(t, thresholds))
+    return findings

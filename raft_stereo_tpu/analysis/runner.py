@@ -1,0 +1,149 @@
+"""graftlint runner: merge both engines, apply the baseline, gate, report.
+
+``python -m raft_stereo_tpu.cli lint`` runs both engines by default
+(``--ast`` / ``--graph`` restrict to one), holds the merged findings
+against the checked-in suppression baseline (``.graftlint.json``), prints
+a human report, optionally writes the JSON report and emits one schema-v4
+``lint`` event, and exits non-zero when any *unsuppressed error-severity*
+finding remains — the gate scripts/rehearse_round.py's ``lint`` leg runs
+every round.
+
+``--update-baseline`` rewrites the baseline from the current findings —
+the escape hatch for intentionally accepting a violation; the diff review
+is the policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from raft_stereo_tpu.analysis.findings import (Finding, apply_baseline,
+                                               baseline_from_findings, gate,
+                                               load_baseline, make_report,
+                                               severity_counts,
+                                               write_baseline)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_lint(graph: bool = True, ast: bool = True,
+             package_root: Optional[str] = None,
+             thresholds: Optional[Dict[str, int]] = None,
+             compile_train: bool = True) -> List[Finding]:
+    """Run the selected engines; raw findings (baseline not applied)."""
+    findings: List[Finding] = []
+    if ast:
+        from raft_stereo_tpu.analysis.ast_rules import run_ast_rules
+        root = package_root or os.path.join(REPO_ROOT, "raft_stereo_tpu")
+        findings.extend(run_ast_rules(root))
+    if graph:
+        from raft_stereo_tpu.analysis.graph_rules import run_graph_rules
+        findings.extend(run_graph_rules(thresholds=thresholds,
+                                        compile_train=compile_train))
+    return findings
+
+
+def _rules_run(graph: bool, ast: bool) -> List[str]:
+    rules: List[str] = []
+    if graph:
+        from raft_stereo_tpu.analysis.graph_rules import GRAPH_RULES
+        rules.extend(GRAPH_RULES)
+    if ast:
+        rules.extend(["tracer-unsafe", "wall-clock", "import-time-jnp",
+                      "cli-drift"])
+    return rules
+
+
+def format_findings(findings: List[Finding],
+                    stale: List[dict]) -> str:
+    lines: List[str] = []
+    for f in sorted(findings, key=lambda f: ("ewi".index(f.severity[0]),
+                                             f.location)):
+        mark = " [suppressed]" if f.suppressed else ""
+        lines.append(f"{f.severity:7s} {f.rule:28s} {f.location}{mark}")
+        lines.append(f"        {f.message}")
+    for e in stale:
+        lines.append(f"stale   suppression matches nothing: "
+                     f"{e['rule']} @ {e['location']}")
+    unsup = severity_counts(findings, suppressed=False)
+    sup = sum(1 for f in findings if f.suppressed)
+    lines.append(f"graftlint: {unsup['error']} error(s), "
+                 f"{unsup['warning']} warning(s), {unsup['info']} info "
+                 f"({sup} suppressed, {len(stale)} stale suppression(s))")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="raft_stereo_tpu.cli lint",
+        description="graftlint: jaxpr/HLO contract checker + tracer-safety "
+                    "AST lint (see raft_stereo_tpu/analysis/)")
+    p.add_argument("--graph", action="store_true",
+                   help="run only the jaxpr/compiled-artifact rule engine")
+    p.add_argument("--ast", action="store_true",
+                   help="run only the source AST lint")
+    p.add_argument("--no-compile", action="store_true",
+                   help="skip the donated train-step compile (faster; the "
+                        "donation rule needs the executable and is skipped)")
+    p.add_argument("--baseline",
+                   default=os.path.join(REPO_ROOT, ".graftlint.json"),
+                   help="suppression baseline path")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="write the full JSON report here")
+    p.add_argument("--run_dir", default=None,
+                   help="emit a schema-v4 `lint` event into this run dir's "
+                        "events.jsonl")
+    p.add_argument("--package-root", default=None,
+                   help="lint this package tree instead of the installed "
+                        "raft_stereo_tpu/ (fixture trees in tests)")
+    args = p.parse_args(argv)
+
+    graph = args.graph or not args.ast
+    ast_on = args.ast or not args.graph
+
+    findings = run_lint(graph=graph, ast=ast_on,
+                        package_root=args.package_root,
+                        compile_train=not args.no_compile)
+    suppressions = load_baseline(args.baseline)
+    findings, stale = apply_baseline(findings, suppressions)
+
+    if args.update_baseline:
+        doc = baseline_from_findings(
+            [f for f in findings if f.severity == "error"])
+        write_baseline(args.baseline, doc)
+        print(f"baseline rewritten: {args.baseline} "
+              f"({len(doc['suppressions'])} suppression(s))")
+        return 0
+
+    print(format_findings(findings, stale))
+
+    engines = [e for e, on in (("graph", graph), ("ast", ast_on)) if on]
+    report = make_report(findings, _rules_run(graph, ast_on), engines,
+                         stale_suppressions=stale)
+    if args.json_out:
+        os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if args.run_dir:
+        from raft_stereo_tpu.obs import Telemetry
+        tel = Telemetry(args.run_dir, stall_deadline_s=None)
+        tel.emit("lint", source="cli_lint",
+                 findings=len(findings),
+                 errors=report["unsuppressed"]["error"],
+                 warnings=report["unsuppressed"]["warning"],
+                 suppressed=report["suppressed_total"],
+                 engines=engines, rules=report["rules_run"])
+        tel.close()
+    return gate(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,18 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                         "b4-like residency, OFF at b8 where HBM pressure "
                         "inverts the trade; 'corr' saves only the corr "
                         "lookup output, ~180 MB at b8; PERF.md)")
+    g.add_argument("--batched_scan_wgrad", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="custom-VJP refinement scan with batched weight "
+                        "gradients (ops/scan_grad.py): one reverse scan "
+                        "computes data grads, each gate conv's weight grad "
+                        "is a single post-scan contraction (auto: off "
+                        "pending hardware measurement; bench.py A/Bs both)")
+    g.add_argument("--residual_dtype", choices=["float32", "bfloat16"],
+                   default=None,
+                   help="storage dtype for refinement-backward residual "
+                        "stacks (bf16 halves the dominant stack residency; "
+                        "accumulation stays fp32)")
     g.add_argument("--no_remat_loss_tail", action="store_true",
                    help="save the post-scan upsample/loss intermediates "
                         "across the loss backward instead of recomputing "
@@ -87,6 +99,9 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         refinement_save_policy={"auto": None, "on": True, "off": False,
                                 "corr": "corr"}[
             getattr(args, "refinement_save_policy", "auto")],
+        batched_scan_wgrad={"auto": None, "on": True, "off": False}[
+            getattr(args, "batched_scan_wgrad", "auto")],
+        residual_dtype=getattr(args, "residual_dtype", None),
     )
 
 
@@ -328,13 +343,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
       trace (obs/summarize.py),
     * ``compare <baseline> <candidate>`` — regression-gate two runs' event
       logs (obs/compare.py; exit 1 on regression),
+    * ``lint [--graph|--ast]`` — graftlint: jaxpr/HLO contract rules +
+      tracer-safety AST lint (raft_stereo_tpu/analysis/; exit 1 on
+      unsuppressed error-severity findings),
     * ``train`` / ``eval`` — the console entry points, for environments
       without the installed scripts.
     """
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    commands = ("telemetry", "compare", "train", "eval")
+    commands = ("telemetry", "compare", "lint", "train", "eval")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -346,6 +364,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "compare":
         from raft_stereo_tpu.obs.compare import main as compare_main
         return compare_main(rest)
+    if cmd == "lint":
+        from raft_stereo_tpu.analysis.runner import main as lint_main
+        return lint_main(rest)
     # _train_main/_eval_main parse sys.argv via argparse; present the
     # remainder as the whole command line
     sys.argv = [f"{sys.argv[0]} {cmd}"] + rest

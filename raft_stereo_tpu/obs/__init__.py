@@ -25,6 +25,7 @@ from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
                                         read_events, validate_events,
                                         validate_record)
 from raft_stereo_tpu.obs.telemetry import Telemetry
+from raft_stereo_tpu.obs.validate import check_path, check_paths
 from raft_stereo_tpu.obs.summarize import format_summary, summarize_run
 from raft_stereo_tpu.obs.xla import (compact_xla_summary,
                                      introspect_compiled)
@@ -33,6 +34,7 @@ from raft_stereo_tpu.obs.compare import compare_runs
 __all__ = [
     "EVENT_TYPES", "SCHEMA_VERSION", "SUPPORTED_SCHEMA_VERSIONS",
     "append_json_log", "make_record", "read_events", "validate_events",
-    "validate_record", "Telemetry", "format_summary", "summarize_run",
+    "validate_record", "check_path", "check_paths", "Telemetry",
+    "format_summary", "summarize_run",
     "introspect_compiled", "compact_xla_summary", "compare_runs",
 ]

@@ -27,15 +27,16 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # Back-compat: every schema version whose artifacts are still readable.
-# v1 -> v2 (the xla_memory/xla_cost introspection events) and v2 -> v3 (the
-# op_counts jaxpr profile event) were purely ADDITIVE — no earlier event
-# changed its required fields — so pre-existing runs/*/events.jsonl lint
-# clean: an older record is validated against its own surface (it just may
-# not use events introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3)
+# v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
+# op_counts jaxpr profile event) and v3 -> v4 (the graftlint `lint` report
+# event) were purely ADDITIVE — no earlier event changed its required
+# fields — so pre-existing runs/*/events.jsonl lint clean: an older record
+# is validated against its own surface (it just may not use events
+# introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -43,6 +44,7 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "xla_memory": 2,
     "xla_cost": 2,
     "op_counts": 3,
+    "lint": 4,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -78,6 +80,11 @@ EVENT_TYPES: Dict[str, tuple] = {
     # iteration wgrad convs replaced by post-scan contractions"
     # (scripts/scan_wgrad_evidence.py).
     "op_counts": ("source", "conv_total"),
+    # Static-analysis report (raft_stereo_tpu/analysis, schema v4): one
+    # record per `cli lint` invocation — total findings plus the
+    # error/warning/suppressed split and the rules that ran; the JSON
+    # report carries the per-finding detail.
+    "lint": ("source", "findings"),
     "stall": ("seconds_since_step", "deadline_s"),
     "error": ("error",),
     "run_end": ("steps",),

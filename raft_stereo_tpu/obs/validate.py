@@ -1,0 +1,62 @@
+"""Artifact-level event validation — the one copy of the check logic.
+
+scripts/check_events.py (the CLI), scripts/rehearse_round.py's ``events``
+leg and the analysis test fixtures all validate the same way: resolve a
+path (file or run directory) to its ``events.jsonl``, parse it, and hold
+every record against the schema (obs/events.py). Before this module the
+path-resolution/empty-log/unparseable handling lived in the script only,
+so library callers re-implemented it; now the script is a thin CLI over
+:func:`check_path`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence
+
+from raft_stereo_tpu.obs.events import read_events, validate_events
+
+
+def check_path(path: str) -> List[str]:
+    """Validate one ``events.jsonl`` (or a run directory containing one).
+
+    Returns ``["<path>: <violation>", ...]`` — empty means the artifact
+    conforms. A missing file and an empty log are violations: an artifact
+    that silently vanished is exactly what a lint must not bless.
+    """
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        return [f"{path}: missing"]
+    try:
+        records = read_events(path)
+    except ValueError as e:
+        return [str(e)]
+    if not records:
+        return [f"{path}: empty event log"]
+    return [f"{path}: {e}" for e in validate_events(records)]
+
+
+def check_paths(paths: Iterable[str]) -> List[str]:
+    """Validate several artifacts; concatenated :func:`check_path` output."""
+    errors: List[str] = []
+    for path in paths:
+        errors.extend(check_path(path))
+    return errors
+
+
+def main(argv: Optional[Sequence[str]] = None,
+         doc: Optional[str] = None) -> int:
+    """The check-events CLI body: lint each argument, report, exit 1 on any
+    violation. ``doc`` is the usage text printed when no paths are given."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print((doc or __doc__).strip(), file=sys.stderr)
+        return 2
+    errors = check_paths(argv)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if not errors:
+        print(f"ok: {len(argv)} artifact(s) conform to the event schema")
+    return 1 if errors else 0

@@ -178,7 +178,9 @@ def compact_xla_summary(analysis: Dict[str, Optional[Dict[str, Any]]]
 # that shows it (scripts/scan_wgrad_evidence.py, `op_counts` events), without
 # needing a TPU or even an XLA compile.
 
-def _iter_subjaxprs(params):
+def iter_subjaxprs(params):
+    """Every sub-jaxpr held by one equation's params (pjit/remat/custom_vjp/
+    cond/while/scan bodies), unwrapped to plain ``Jaxpr``s."""
     import jax.core as jcore
     for v in params.values():
         vals = v if isinstance(v, (list, tuple)) else (v,)
@@ -187,6 +189,29 @@ def _iter_subjaxprs(params):
                 yield item.jaxpr
             elif isinstance(item, jcore.Jaxpr):
                 yield item
+
+
+_iter_subjaxprs = iter_subjaxprs  # back-compat alias
+
+
+def iter_eqns(jaxpr, path: str = "top"):
+    """Depth-first ``(eqn, path)`` walk of a jaxpr and every sub-jaxpr.
+
+    ``path`` names the nesting chain with primitive names — scan bodies are
+    indexed (``top/scan[0]/...``) in jaxpr order so a rule finding anchored
+    to a path is stable across unrelated edits. This is the generic walker
+    the analysis/ graph rules share with the conv profilers below."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    scan_i = 0
+    for eqn in jaxpr.eqns:
+        yield eqn, path
+        if eqn.primitive.name == "scan":
+            sub_path = f"{path}/scan[{scan_i}]"
+            scan_i += 1
+        else:
+            sub_path = f"{path}/{eqn.primitive.name}"
+        for sub in iter_subjaxprs(eqn.params):
+            yield from iter_eqns(sub, sub_path)
 
 
 def _count_convs(jaxpr) -> int:

@@ -1,21 +1,18 @@
 #!/usr/bin/env python
-"""Schema lint for events.jsonl artifacts (obs/events.py).
+"""Schema lint for events.jsonl artifacts — thin CLI over obs/validate.py.
 
 Validates every record of one or more ``events.jsonl`` files (or run
 directories containing one) against the supported schema versions and each
-event type's required fields — the streaming-eval ``pipeline`` gauge
-(``in_flight`` required), the v2 compiled-artifact introspection records
-``xla_memory`` (``source``/``peak_bytes``) and ``xla_cost``
-(``source``/``flops``), and the v3 jaxpr conv-placement profile
-``op_counts`` (``source``/``conv_total``, the batched-weight-grad scan's
-structural evidence) — newer events additionally may not claim a schema
-older than their introduction — and exits non-zero on any violation; wired
-into the tier-1 run via tests/test_telemetry.py, tests/test_eval_stream.py,
-tests/test_obs_xla.py and tests/test_scan_grad.py so schema drift fails
-tests instead of silently corrupting downstream summarizers.
+event type's required fields (obs/events.py), and exits non-zero on any
+violation. The validation logic lives in
+``raft_stereo_tpu.obs.validate`` — shared with scripts/rehearse_round.py's
+``events`` leg and the graftlint test fixtures — so the CLI and the
+library can never drift apart.
 
-Back-compat: v1 -> v2 -> v3 were additive (obs/events.py
-``SUPPORTED_SCHEMA_VERSIONS``), so pre-existing artifacts lint clean.
+Back-compat: v1 -> v2 -> v3 -> v4 were additive (obs/events.py
+``SUPPORTED_SCHEMA_VERSIONS``), so pre-existing artifacts lint clean; the
+v4 addition is the ``lint`` static-analysis report event
+(raft_stereo_tpu/analysis).
 
 Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
 """
@@ -25,37 +22,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from raft_stereo_tpu.obs.events import read_events, validate_events  # noqa: E402
+from raft_stereo_tpu.obs.validate import check_path, main as _main  # noqa: E402
 
-
-def check(path: str) -> list:
-    """Return ["<path>: <violation>", ...] for one file or run dir."""
-    if os.path.isdir(path):
-        path = os.path.join(path, "events.jsonl")
-    if not os.path.exists(path):
-        return [f"{path}: missing"]
-    try:
-        records = read_events(path)
-    except ValueError as e:
-        return [str(e)]
-    if not records:
-        return [f"{path}: empty event log"]
-    return [f"{path}: {e}" for e in validate_events(records)]
+# Back-compat alias: scripts/rehearse_round.py (and older callers) import
+# ``check_events.check``.
+check = check_path
 
 
 def main(argv=None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if not argv:
-        print(__doc__.strip(), file=sys.stderr)
-        return 2
-    errors = []
-    for path in argv:
-        errors.extend(check(path))
-    for e in errors:
-        print(e, file=sys.stderr)
-    if not errors:
-        print(f"ok: {len(argv)} artifact(s) conform to the event schema")
-    return 1 if errors else 0
+    return _main(argv, doc=__doc__)
 
 
 if __name__ == "__main__":

@@ -33,13 +33,20 @@ rehearsal:
   before round end; a throughput regression in the same path is what the
   compare leg gates (the bench chain's scan A/B attempt writes into
   ``runs/bench/current``).
+* **lint** — graftlint (r9): ``python -m raft_stereo_tpu.cli lint`` under
+  ``JAX_PLATFORMS=cpu`` — the jaxpr/compiled-artifact contract rules
+  (wgrad placement, dtype policy, donation, host-sync, carry/constant
+  size) plus the tracer-safety AST lint, gated on unsuppressed
+  error-severity findings against the checked-in ``.graftlint.json``
+  baseline. A structural regression in the hot path fails the rehearsal
+  even when every numeric test still passes.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
 the rehearsal can gate a round's end ritual.
 
 Run: python scripts/rehearse_round.py
-     [--legs bench multichip events compare scangrad]
+     [--legs bench multichip events compare scangrad lint]
      [--bench-budget S] [--multichip-budget S] [--baseline RUN_DIR]
 """
 
@@ -155,10 +162,11 @@ def main(argv=None):
                     "driver's budgets (see module doc)")
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
-                            "scangrad"],
+                            "scangrad", "lint"],
                    choices=["bench", "multichip", "events", "compare",
-                            "scangrad"])
+                            "scangrad", "lint"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
+    p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -198,6 +206,10 @@ def main(argv=None):
             [sys.executable, "-m", "pytest", "tests/test_scan_grad.py",
              "-q", "-m", "not slow", "-p", "no:cacheprovider"],
             args.scangrad_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "lint" in args.legs:
+        records.append(run_leg(
+            "lint", [sys.executable, "-m", "raft_stereo_tpu.cli", "lint"],
+            args.lint_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

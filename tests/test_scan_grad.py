@@ -308,10 +308,14 @@ def test_uninitialized_params_raise(setup):
 # ------------------------------------------------------- structural evidence
 
 def test_wgrads_hoisted_out_of_backward_scan(setup):
-    """The acceptance-criterion structure, pinned at the jaxpr level: the
-    custom path's backward scan body carries FEWER convolutions per
-    iteration (the per-iteration weight-grad convs are gone) and the
-    outside-scan graph gains the batched contractions."""
+    """The acceptance-criterion structure, pinned at the jaxpr level
+    THROUGH the shared graftlint rule (analysis/graph_rules.py
+    ``wgrad-in-loop``): the custom path's backward scan body carries FEWER
+    convolutions per iteration (3 GRU levels x (zr + q) = 6 weight-grad
+    convs leave the loop body) and the outside-scan graph gains the
+    batched contractions. Asserting through ``check_wgrad_hoisting`` means
+    this test and ``cli lint`` cannot drift apart."""
+    from raft_stereo_tpu.analysis.graph_rules import check_wgrad_hoisting
     from raft_stereo_tpu.obs.xla import conv_op_profile
 
     variables, img1, img2, gt, valid = setup
@@ -323,14 +327,13 @@ def test_wgrads_hoisted_out_of_backward_scan(setup):
             jax.grad(stacked_loss(m, variables, img1, img2)))(
                 variables["params"])
         profiles[name] = conv_op_profile(jaxpr)
-    bwd_auto = profiles["autodiff"]["scans"][-1]["convs_per_step"]
-    bwd_cust = profiles["batched"]["scans"][-1]["convs_per_step"]
-    out_auto = profiles["autodiff"]["outside_scans"]
-    out_cust = profiles["batched"]["outside_scans"]
-    # 3 GRU levels x (zr + q) = 6 weight-grad convs leave the loop body...
-    assert bwd_cust <= bwd_auto - 6 + 3, (bwd_auto, bwd_cust)
-    # ...and at least 6 batched contractions appear outside it.
-    assert out_cust >= out_auto + 6, (out_auto, out_cust)
+    findings = check_wgrad_hoisting(profiles["autodiff"],
+                                    profiles["batched"])
+    assert findings == [], [f.message for f in findings]
+    # the rule is live: feeding the autodiff profile as "batched" (nothing
+    # hoisted) must fire it
+    assert check_wgrad_hoisting(profiles["autodiff"],
+                                profiles["autodiff"])
 
 
 def test_op_counts_event_schema(tmp_path, setup):
