@@ -1,6 +1,7 @@
 """graftlint — static analysis over the lowered graph and the source tree.
 
-Two engines, one report format (findings.py):
+Three engines plus a structural regression gate, one report format
+(findings.py):
 
 * graph_rules.py — declarative contract rules over the canonical train
   step and inference lowerings (jaxpr + compiled artifact): op placement
@@ -9,11 +10,24 @@ Two engines, one report format (findings.py):
   donation aliasing, scan carry size, folded-constant size.
 * ast_rules.py — tracer-safety lint over the package source:
   concretizing calls and wall-clock reads in jit-reachable functions,
-  module-import-time ``jnp`` work, argparse <-> config drift.
+  module-import-time ``jnp`` work, argparse <-> config drift across the
+  shared ``cli.py`` builders and the entry-script surfaces.
+* spmd_rules.py — SPMD contracts over the canonical *sharded* lowerings
+  on a fake 8-device host mesh: collective placement
+  (``collective-in-loop``, ring-rotation whitelisted by structure),
+  sharding propagation (``accidental-replication``), reduction dtype
+  (``collective-dtype``), axis plumbing (``axis-leak``), donation under
+  partitioning (``donation-under-mesh``).
+* fingerprint.py — each canonical executable distilled to a checked-in
+  structural fingerprint (``.graftlint-fingerprint.json``: conv
+  placement, collective kinds in/out of loop, peak bytes, donation
+  pairs); ``cli lint --fingerprint`` fails on drift.
 
 Entry point: ``python -m raft_stereo_tpu.cli lint`` (runner.py) — exits
 non-zero on unsuppressed error-severity findings; ``.graftlint.json`` at
-the repo root is the checked-in suppression baseline.
+the repo root is the checked-in suppression baseline (entries carry the
+``rule_version`` they were written against; version bumps flag them
+stale instead of silently matching).
 """
 
 from raft_stereo_tpu.analysis.findings import (Finding, apply_baseline,

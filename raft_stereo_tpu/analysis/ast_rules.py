@@ -39,6 +39,18 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from raft_stereo_tpu.analysis.findings import Finding
 
+#: current semantic version per rule (baseline entries record the version
+#: they suppress; a bump flags them stale — findings.apply_baseline).
+#: cli-drift is v2: the PR-6 extension to the evaluate_stereo/demo parser
+#: surfaces and the bench config-constructor call sites widened what the
+#: rule checks, so v1-era suppressions no longer mean what they said.
+RULE_VERSIONS: Dict[str, int] = {
+    "tracer-unsafe": 1,
+    "wall-clock": 1,
+    "import-time-jnp": 1,
+    "cli-drift": 2,
+}
+
 # Call names (last attribute segment) that trace their function arguments.
 TRACING_TRANSFORMS = frozenset({
     "jit", "pmap", "grad", "value_and_grad", "vmap", "checkpoint", "remat",
@@ -442,6 +454,143 @@ def check_cli_config_drift(cli_path: str, relpath: str) -> List[Finding]:
     return findings
 
 
+# --- entry-script surfaces (evaluate_stereo / demo / bench) ------------------
+#
+# The v1 rule checked only the shared add_model_args/add_train_args pairs;
+# the other de-facto public surfaces drift the same way: the eval/demo
+# parser builders whose flags are consumed across module boundaries
+# (evaluate_stereo.py/demo.py wrap builders living in cli.py), and the
+# bench harness's direct RAFTStereoConfig/TrainConfig constructor calls
+# (a typo'd keyword there only explodes on benchmark day).
+
+#: parser-builder function in cli.py -> module relpaths allowed to consume
+#: its flags (declaration and consumption legitimately live in different
+#: files; a dest no file reads is parsed-then-dropped)
+ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("build_eval_parser", ("raft_stereo_tpu/cli.py", "evaluate_stereo.py")),
+    ("build_demo_parser", ("raft_stereo_tpu/cli.py", "demo.py")),
+)
+
+#: modules whose own argparse surface must be self-consumed, and whose
+#: config-constructor keywords are checked against the dataclass fields
+ENTRY_SCRIPTS: Tuple[str, ...] = ("bench.py", "scripts/bench_inference.py")
+
+
+def _parse_file(path: str) -> Optional[ast.Module]:
+    try:
+        with open(path) as f:
+            return ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _module_args_reads(tree: ast.Module) -> Set[str]:
+    """Every ``args.<x>`` / ``getattr(args, "x")`` read anywhere in a
+    module (any function; the conventional namespace name is ``args``)."""
+    reads: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "args":
+            reads.add(node.attr)
+        if isinstance(node, ast.Call) \
+                and _last_attr(node.func) == "getattr" \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id == "args" \
+                and isinstance(node.args[1], ast.Constant):
+            reads.add(node.args[1].value)
+    return reads
+
+
+def _config_ctor_kwargs(tree: ast.Module) -> List[Tuple[str, str, str, int]]:
+    """(class name, keyword, enclosing scope, line) for every keyword passed
+    to a RAFTStereoConfig/TrainConfig constructor call in the module.
+    ``**kwargs`` splats are invisible to this check by design."""
+    scopes: Dict[int, str] = {}
+    for top in tree.body:
+        if isinstance(top, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(top):
+                scopes.setdefault(id(sub), top.name)
+    out: List[Tuple[str, str, str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _last_attr(node.func) in ("RAFTStereoConfig",
+                                              "TrainConfig"):
+            scope = scopes.get(id(node), "<module>")
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    out.append((_last_attr(node.func), kw.arg, scope,
+                                node.lineno))
+    return out
+
+
+def check_entry_surface_drift(repo_root: str) -> List[Finding]:
+    """cli-drift over the entry-script surfaces (rule v2): eval/demo parser
+    flags must be consumed somewhere in their consumer set, script-local
+    argparse flags must be consumed in their own module, and every config
+    constructor keyword in the bench harnesses must name a real field."""
+    import dataclasses as dc
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+
+    fields = {"RAFTStereoConfig": {f.name for f in dc.fields(RAFTStereoConfig)},
+              "TrainConfig": {f.name for f in dc.fields(TrainConfig)}}
+    findings: List[Finding] = []
+    trees: Dict[str, Optional[ast.Module]] = {}
+
+    def tree_for(rel: str) -> Optional[ast.Module]:
+        if rel not in trees:
+            trees[rel] = _parse_file(os.path.join(repo_root, rel))
+        return trees[rel]
+
+    cli_tree = tree_for("raft_stereo_tpu/cli.py")
+    if cli_tree is not None:
+        builders = {n.name: n for n in cli_tree.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        for builder, consumers in ENTRY_SURFACES:
+            fn = builders.get(builder)
+            if fn is None:
+                continue
+            dests = _argparse_dests(fn)
+            consumed: Set[str] = set()
+            for rel in consumers:
+                t = tree_for(rel)
+                if t is not None:
+                    consumed |= _module_args_reads(t)
+            for d in sorted(dests - consumed):
+                findings.append(Finding(
+                    rule="cli-drift", severity="error",
+                    location=f"raft_stereo_tpu/cli.py::{builder}",
+                    message=f"flag --{d} is declared in {builder}() but no "
+                            f"consumer module ({', '.join(consumers)}) ever "
+                            f"reads args.{d} — parsed then dropped",
+                    data={"dest": d, "surface": builder}))
+    for rel in ENTRY_SCRIPTS:
+        t = tree_for(rel)
+        if t is None:
+            continue
+        dests = _argparse_dests(t)
+        consumed = _module_args_reads(t)
+        for d in sorted(dests - consumed):
+            findings.append(Finding(
+                rule="cli-drift", severity="error",
+                location=f"{rel}::<module>",
+                message=f"flag --{d} is declared but args.{d} is never "
+                        f"read in {rel} — parsed then dropped",
+                data={"dest": d}))
+        for cls, kw, scope, line in _config_ctor_kwargs(t):
+            if kw not in fields[cls]:
+                findings.append(Finding(
+                    rule="cli-drift", severity="error",
+                    location=f"{rel}::{scope}",
+                    message=f"{scope}() passes keyword {kw!r} to {cls} "
+                            f"but no such field exists (line {line})",
+                    data={"keyword": kw, "class": cls, "line": line}))
+    return findings
+
+
 # --- engine ------------------------------------------------------------------
 
 def lint_source(text: str, relpath: str) -> List[Finding]:
@@ -493,4 +642,5 @@ def run_ast_rules(package_root: str,
     if os.path.exists(cli_path):
         findings.extend(check_cli_config_drift(
             cli_path, os.path.relpath(cli_path, repo_root)))
+    findings.extend(check_entry_surface_drift(repo_root))
     return findings

@@ -1,7 +1,8 @@
 """Finding record, JSON report and suppression baseline — graftlint's spine.
 
-Both engines (graph_rules.py over lowered jaxprs/compiled artifacts,
-ast_rules.py over the package source) emit the same record: a rule id, a
+Every engine (graph_rules.py over lowered jaxprs/compiled artifacts,
+ast_rules.py over the package source, spmd_rules.py over the sharded
+lowerings, fingerprint.py's drift diff) emits the same record: a rule id, a
 severity, a *line-stable* location, a human message and a machine ``data``
 payload. The runner merges them, applies the checked-in suppression
 baseline (``.graftlint.json`` at the repo root), renders the report and
@@ -77,40 +78,74 @@ def load_baseline(path: str) -> List[Dict[str, Any]]:
 
 
 def apply_baseline(findings: Iterable[Finding],
-                   suppressions: List[Dict[str, Any]]
+                   suppressions: List[Dict[str, Any]],
+                   rule_versions: Optional[Dict[str, int]] = None
                    ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Mark findings matched by the baseline; return (findings, stale).
 
     ``stale`` is the suppressions that matched nothing — a fixed violation
     whose baseline entry should be deleted (reported, never fatal: a stale
-    entry must not block the gate the way a real finding does).
+    entry must not block the gate the way a real finding does). Each stale
+    entry carries a ``stale_reason``.
+
+    ``rule_versions`` (current rule id -> semantic version, merged from the
+    engines that ran) lets a renamed/retired rule or a version bump
+    invalidate its suppressions EXPLICITLY: an entry whose rule is unknown,
+    or whose recorded ``rule_version`` differs from the rule's current
+    version, is flagged stale and never matches — previously such entries
+    were silently inert forever (a rename left zombie suppressions; worse,
+    a rule whose semantics changed kept suppressing findings it no longer
+    meant).
     """
     findings = list(findings)
     used = set()
-    by_key = {(e["rule"], e["location"]): i
-              for i, e in enumerate(suppressions)}
+    stale: List[Dict[str, Any]] = []
+    by_key: Dict[Tuple[str, str], int] = {}
+    for i, e in enumerate(suppressions):
+        if rule_versions is not None:
+            cur = rule_versions.get(e["rule"])
+            if cur is None:
+                stale.append({**e, "stale_reason":
+                              "rule renamed or retired — no engine exposes "
+                              "it anymore"})
+                continue
+            ev = e.get("rule_version")
+            if ev is not None and ev != cur:
+                stale.append({**e, "stale_reason":
+                              f"written against rule_version {ev}, rule is "
+                              f"now v{cur} — re-triage and re-baseline"})
+                continue
+        by_key[(e["rule"], e["location"])] = i
     for f in findings:
         idx = by_key.get(f.key)
         if idx is not None:
             f.suppressed = True
             used.add(idx)
-    stale = [e for i, e in enumerate(suppressions) if i not in used]
+    stale.extend({**e, "stale_reason": "matches nothing (violation fixed)"}
+                 for i, e in enumerate(suppressions)
+                 if i not in used and (e["rule"], e["location"]) in by_key)
     return findings, stale
 
 
 def baseline_from_findings(findings: Iterable[Finding],
-                           reason: str = "baselined pre-existing finding"
+                           reason: str = "baselined pre-existing finding",
+                           rule_versions: Optional[Dict[str, int]] = None
                            ) -> Dict[str, Any]:
     """Serialize current unsuppressed findings as a fresh baseline doc
-    (the ``--update-baseline`` round-trip)."""
+    (the ``--update-baseline`` round-trip). When ``rule_versions`` is
+    given, each entry records the version of the rule it suppresses, so a
+    future semantic bump flags it stale instead of silently matching."""
     seen = set()
     entries = []
     for f in findings:
         if f.suppressed or f.key in seen:
             continue
         seen.add(f.key)
-        entries.append({"rule": f.rule, "location": f.location,
-                        "reason": reason, "severity": f.severity})
+        entry = {"rule": f.rule, "location": f.location,
+                 "reason": reason, "severity": f.severity}
+        if rule_versions and f.rule in rule_versions:
+            entry["rule_version"] = rule_versions[f.rule]
+        entries.append(entry)
     return {"version": BASELINE_VERSION, "suppressions": entries}
 
 

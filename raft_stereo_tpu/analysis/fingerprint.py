@@ -1,0 +1,294 @@
+"""Compiled-executable fingerprints: the structural regression gate.
+
+The r7/r8 evidence scripts (scan_wgrad_evidence, serial_floor,
+alloc_breakdown) each proved a structural claim ONCE — the wgrad convs are
+out of the backward loop, the collectives are the ones the sharding story
+names, the peak residency is what the round banked. Nothing re-checked
+those claims afterwards; a refactor could quietly undo any of them and the
+numeric tests would stay green. This module distills every canonical
+lowering (the PR-5 unsharded set from graph_rules.build_targets plus the
+sharded set from spmd_rules.build_spmd_targets) into a small JSON
+fingerprint and diffs HEAD against the checked-in baseline
+(``.graftlint-fingerprint.json``):
+
+* conv placement — ``conv_op_profile``: convs outside scans and per scan
+  body (a rise in the last scan's per-step count = the weight-grad convs
+  re-entered the backward loop);
+* collectives — jaxpr kinds/counts split in-loop vs outside
+  (``collective_profile``), plus the compiled post-partitioning kinds
+  (``hlo_collective_profile``): a NEW collective kind or one moving into
+  the loop is exactly the drift the SPMD rules exist for;
+* peak bytes — ``memory_analysis`` of the compiled executable, gated by a
+  relative threshold (default 10%);
+* donation — declared flag + whether the executable actually aliases.
+
+``cli lint --fingerprint`` runs the diff (drift becomes ordinary
+error-severity findings, so the one gate/baseline/report machinery
+applies); ``--update-fingerprint`` regenerates the baseline — the diff
+review of that file IS the approval of a structural change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from raft_stereo_tpu.analysis.findings import Finding
+
+FINGERPRINT_VERSION = 1
+DEFAULT_FINGERPRINT = ".graftlint-fingerprint.json"
+
+#: relative peak-bytes growth tolerated before the gate trips
+DEFAULT_PEAK_TOLERANCE = 0.10
+
+RULE = "fingerprint-drift"
+RULE_VERSIONS: Dict[str, int] = {RULE: 1}
+
+
+def target_fingerprint(target) -> Dict[str, Any]:
+    """Distill one Graph/Spmd target into its structural fingerprint."""
+    from raft_stereo_tpu.obs.xla import (collective_profile,
+                                         conv_op_profile,
+                                         hlo_collective_profile,
+                                         memory_analysis_dict)
+
+    conv = conv_op_profile(target.closed_jaxpr)
+    coll = collective_profile(target.closed_jaxpr)
+    rec: Dict[str, Any] = {
+        "convs": {"outside_scans": conv["outside_scans"],
+                  "scans": [{"length": s["length"],
+                             "convs_per_step": s["convs_per_step"]}
+                            for s in conv["scans"]],
+                  "total": conv["total"]},
+        "collectives": {"by_kind": coll["by_kind"],
+                        "in_loop": coll["in_loop"]},
+    }
+    compiled = getattr(target, "compiled", None)
+    if compiled is not None:
+        mem = memory_analysis_dict(compiled)
+        if mem is not None:
+            rec["peak_bytes"] = mem["peak_bytes"]
+            rec["donation"] = {
+                "declared": bool(getattr(target, "donate_declared", False)),
+                "aliased": mem.get("alias_bytes", 0) > 0,
+                "alias_bytes": mem.get("alias_bytes", 0),
+            }
+        hlo = None
+        getter = getattr(target, "hlo_text", None)
+        if callable(getter):
+            hlo = getter()
+        else:
+            try:
+                hlo = compiled.as_text()
+            except Exception:
+                hlo = None
+        if hlo is not None:
+            hprof = hlo_collective_profile(hlo)
+            rec["hlo_collectives"] = {"by_kind": hprof["by_kind"],
+                                      "in_loop": hprof["in_loop"]}
+    return rec
+
+
+def compute_fingerprint(targets) -> Dict[str, Any]:
+    """Fingerprint doc over a target list (names must be unique)."""
+    import jax
+
+    return {
+        "version": FINGERPRINT_VERSION,
+        "meta": {"jax": jax.__version__,
+                 "platform": jax.default_backend(),
+                 "device_count": len(jax.devices())},
+        "targets": {t.name: target_fingerprint(t) for t in targets},
+    }
+
+
+def load_fingerprint(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != FINGERPRINT_VERSION:
+        raise ValueError(f"{path}: fingerprint version "
+                         f"{doc.get('version')!r} != {FINGERPRINT_VERSION}")
+    return doc
+
+
+def write_fingerprint(path: str, doc: Dict[str, Any]) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _err(loc: str, msg: str, **data) -> Finding:
+    return Finding(rule=RULE, severity="error",
+                   location=f"fingerprint/{loc}", message=msg, data=data)
+
+
+def _warn(loc: str, msg: str, **data) -> Finding:
+    return Finding(rule=RULE, severity="warning",
+                   location=f"fingerprint/{loc}", message=msg, data=data)
+
+
+def _info(loc: str, msg: str, **data) -> Finding:
+    return Finding(rule=RULE, severity="info",
+                   location=f"fingerprint/{loc}", message=msg, data=data)
+
+
+def _diff_convs(name: str, base: Dict, cur: Dict) -> List[Finding]:
+    out: List[Finding] = []
+    if base["outside_scans"] != cur["outside_scans"]:
+        out.append(_err(
+            f"{name}/convs",
+            f"convs outside scans moved {base['outside_scans']} -> "
+            f"{cur['outside_scans']} — op placement changed",
+            baseline=base["outside_scans"], current=cur["outside_scans"]))
+    if len(base["scans"]) != len(cur["scans"]):
+        out.append(_err(
+            f"{name}/convs",
+            f"scan count changed {len(base['scans'])} -> "
+            f"{len(cur['scans'])} — the loop structure itself moved",
+            baseline=len(base["scans"]), current=len(cur["scans"])))
+        return out
+    for i, (b, c) in enumerate(zip(base["scans"], cur["scans"])):
+        if b["convs_per_step"] != c["convs_per_step"]:
+            last = i == len(base["scans"]) - 1
+            extra = (" — the weight-grad convs re-entered the backward "
+                     "loop body" if last
+                     and c["convs_per_step"] > b["convs_per_step"] else "")
+            out.append(_err(
+                f"{name}/convs/scan[{i}]",
+                f"convs per scan step moved {b['convs_per_step']} -> "
+                f"{c['convs_per_step']}{extra}",
+                baseline=b["convs_per_step"], current=c["convs_per_step"]))
+        if b["length"] != c["length"]:
+            out.append(_warn(
+                f"{name}/convs/scan[{i}]",
+                f"scan length moved {b['length']} -> {c['length']}",
+                baseline=b["length"], current=c["length"]))
+    return out
+
+
+def _diff_collectives(name: str, kind: str, base: Dict, cur: Dict,
+                      hlo: bool = False) -> List[Finding]:
+    out: List[Finding] = []
+    for k in sorted(set(cur["by_kind"]) - set(base["by_kind"])):
+        out.append(_err(
+            f"{name}/{kind}",
+            f"NEW collective `{k}` (x{cur['by_kind'][k]}) not in the "
+            f"baseline — the sharding structure grew a reduction/exchange "
+            f"the contract never named",
+            collective=k, count=cur["by_kind"][k]))
+    for k in sorted(set(base["by_kind"]) - set(cur["by_kind"])):
+        out.append(_warn(
+            f"{name}/{kind}",
+            f"collective `{k}` (baseline x{base['by_kind'][k]}) "
+            f"disappeared — if intentional, --update-fingerprint",
+            collective=k))
+    for k in sorted(set(base["by_kind"]) & set(cur["by_kind"])):
+        if base["by_kind"][k] != cur["by_kind"][k]:
+            mk = _warn if hlo else _err
+            out.append(mk(
+                f"{name}/{kind}/{k}",
+                f"`{k}` count moved {base['by_kind'][k]} -> "
+                f"{cur['by_kind'][k]}",
+                baseline=base["by_kind"][k], current=cur["by_kind"][k]))
+    for k in sorted(set(cur["in_loop"]) - set(base["in_loop"])):
+        out.append(_err(
+            f"{name}/{kind}/in-loop",
+            f"collective `{k}` MOVED INTO a loop body "
+            f"(x{cur['in_loop'][k]} per iteration; baseline ran it only "
+            f"outside) — per-iteration ICI traffic on the serial chain",
+            collective=k, count=cur["in_loop"][k]))
+    for k in sorted(set(base["in_loop"]) & set(cur["in_loop"])):
+        if base["in_loop"][k] != cur["in_loop"][k]:
+            mk = _warn if hlo else _err
+            out.append(mk(
+                f"{name}/{kind}/in-loop/{k}",
+                f"in-loop `{k}` count moved {base['in_loop'][k]} -> "
+                f"{cur['in_loop'][k]}",
+                baseline=base["in_loop"][k], current=cur["in_loop"][k]))
+    return out
+
+
+def diff_fingerprint(baseline: Dict[str, Any], current: Dict[str, Any],
+                     peak_tolerance: float = DEFAULT_PEAK_TOLERANCE,
+                     partial: bool = False) -> List[Finding]:
+    """Structural drift between two fingerprint docs, as findings.
+
+    ``partial=True`` means the current doc was computed from a subset of
+    the canonical targets (an engine was deselected or compilation was
+    skipped): baseline-only targets/fields are then skipped, not failed.
+    Full runs treat a missing target or field as drift — "nothing to
+    compare" must never read as "no regression".
+    """
+    out: List[Finding] = []
+    bmeta, cmeta = baseline.get("meta", {}), current.get("meta", {})
+    if bmeta.get("jax") != cmeta.get("jax"):
+        out.append(_info(
+            "meta", f"baseline was written under jax {bmeta.get('jax')!r}, "
+                    f"running {cmeta.get('jax')!r} — op counts may shift "
+                    f"legitimately; regenerate if the diff is noise",
+            baseline=bmeta.get("jax"), current=cmeta.get("jax")))
+    btargets = baseline.get("targets", {})
+    ctargets = current.get("targets", {})
+    for name in sorted(set(ctargets) - set(btargets)):
+        out.append(_err(
+            name, "target not in the baseline — regenerate with "
+                  "--update-fingerprint to adopt it",
+            target=name))
+    for name in sorted(set(btargets) - set(ctargets)):
+        if not partial:
+            out.append(_err(
+                name, "canonical target missing from the current build — "
+                      "a lowering was dropped or failed",
+                target=name))
+    for name in sorted(set(btargets) & set(ctargets)):
+        b, c = btargets[name], ctargets[name]
+        out.extend(_diff_convs(name, b["convs"], c["convs"]))
+        out.extend(_diff_collectives(name, "collectives",
+                                     b["collectives"], c["collectives"]))
+        for field in ("hlo_collectives", "peak_bytes", "donation"):
+            if field in b and field not in c:
+                if not partial:
+                    out.append(_err(
+                        f"{name}/{field}",
+                        f"baseline records `{field}` but the current build "
+                        f"did not produce it (compile skipped?)",
+                        field=field))
+                continue
+        if "hlo_collectives" in b and "hlo_collectives" in c:
+            out.extend(_diff_collectives(name, "hlo_collectives",
+                                         b["hlo_collectives"],
+                                         c["hlo_collectives"], hlo=True))
+        if "peak_bytes" in b and "peak_bytes" in c:
+            pb, pc = b["peak_bytes"], c["peak_bytes"]
+            rel = (pc - pb) / pb if pb else 0.0
+            if rel > peak_tolerance:
+                out.append(_err(
+                    f"{name}/peak_bytes",
+                    f"executable peak bytes jumped {pb} -> {pc} "
+                    f"(+{100 * rel:.1f}% > {100 * peak_tolerance:.0f}% "
+                    f"threshold)",
+                    baseline=pb, current=pc, rel=round(rel, 4)))
+            elif rel < -peak_tolerance:
+                out.append(_info(
+                    f"{name}/peak_bytes",
+                    f"peak bytes improved {pb} -> {pc} "
+                    f"({100 * rel:.1f}%) — bank it with "
+                    f"--update-fingerprint",
+                    baseline=pb, current=pc, rel=round(rel, 4)))
+        if "donation" in b and "donation" in c:
+            db, dc = b["donation"], c["donation"]
+            if db["declared"] != dc["declared"] \
+                    or db["aliased"] != dc["aliased"]:
+                out.append(_err(
+                    f"{name}/donation",
+                    f"donation pairing changed: declared "
+                    f"{db['declared']}->{dc['declared']}, aliased "
+                    f"{db['aliased']}->{dc['aliased']} — the state's "
+                    f"double-buffering contract moved",
+                    baseline=db, current=dc))
+    return out
+
+
+def fingerprint_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, DEFAULT_FINGERPRINT)

@@ -33,6 +33,19 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from raft_stereo_tpu.analysis.findings import Finding
 
+#: current semantic version per rule (suppression baseline entries record
+#: the version they were written against; findings.apply_baseline flags a
+#: mismatch stale instead of silently matching a changed rule)
+RULE_VERSIONS: Dict[str, int] = {
+    "wgrad-in-loop": 1,
+    "dtype-drift": 1,
+    "residual-dtype-conformance": 1,
+    "host-sync": 1,
+    "donation": 1,
+    "carry-growth": 1,
+    "constant-bloat": 1,
+}
+
 # Thresholds a caller (or a fixture test) can override per run.
 DEFAULT_THRESHOLDS: Dict[str, int] = {
     # scan carry resident per backward iteration — warn past this

@@ -1,16 +1,21 @@
-"""graftlint runner: merge both engines, apply the baseline, gate, report.
+"""graftlint runner: merge all engines, apply the baseline, gate, report.
 
-``python -m raft_stereo_tpu.cli lint`` runs both engines by default
-(``--ast`` / ``--graph`` restrict to one), holds the merged findings
-against the checked-in suppression baseline (``.graftlint.json``), prints
-a human report, optionally writes the JSON report and emits one schema-v4
-``lint`` event, and exits non-zero when any *unsuppressed error-severity*
-finding remains — the gate scripts/rehearse_round.py's ``lint`` leg runs
-every round.
+``python -m raft_stereo_tpu.cli lint`` runs every engine by default
+(``--ast`` / ``--graph`` / ``--spmd`` restrict the set), holds the merged
+findings against the checked-in suppression baseline (``.graftlint.json``),
+prints a human report, optionally writes the JSON report and emits one
+schema-v4 ``lint`` event, and exits non-zero when any *unsuppressed
+error-severity* finding remains — the gate scripts/rehearse_round.py's
+``lint`` leg runs every round.
 
-``--update-baseline`` rewrites the baseline from the current findings —
-the escape hatch for intentionally accepting a violation; the diff review
-is the policy.
+``--fingerprint`` additionally diffs the canonical executables' structural
+fingerprint (conv placement, collective kinds/counts, peak bytes, donation
+pairs — analysis/fingerprint.py) against the checked-in baseline
+(``.graftlint-fingerprint.json``); drift becomes ordinary error findings,
+so the same gate applies. ``--update-baseline`` / ``--update-fingerprint``
+rewrite the respective baselines from the current state — the escape hatch
+for intentionally accepting a violation or a structural change; the diff
+review is the policy.
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from raft_stereo_tpu.analysis.findings import (Finding, apply_baseline,
                                                baseline_from_findings, gate,
@@ -31,31 +36,91 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def run_lint(graph: bool = True, ast: bool = True,
+def rule_versions(graph: bool = True, ast: bool = True,
+                  spmd: bool = True,
+                  fingerprint: bool = True) -> Dict[str, int]:
+    """Current rule id -> semantic version over the selected engines (the
+    map baseline entries are validated against)."""
+    versions: Dict[str, int] = {}
+    if graph:
+        from raft_stereo_tpu.analysis.graph_rules import \
+            RULE_VERSIONS as graph_v
+        versions.update(graph_v)
+    if ast:
+        from raft_stereo_tpu.analysis.ast_rules import \
+            RULE_VERSIONS as ast_v
+        versions.update(ast_v)
+    if spmd:
+        from raft_stereo_tpu.analysis.spmd_rules import \
+            RULE_VERSIONS as spmd_v
+        versions.update(spmd_v)
+    if fingerprint:
+        from raft_stereo_tpu.analysis.fingerprint import \
+            RULE_VERSIONS as fp_v
+        versions.update(fp_v)
+    return versions
+
+
+def run_lint(graph: bool = True, ast: bool = True, spmd: bool = True,
              package_root: Optional[str] = None,
              thresholds: Optional[Dict[str, int]] = None,
-             compile_train: bool = True) -> List[Finding]:
-    """Run the selected engines; raw findings (baseline not applied)."""
+             spmd_thresholds: Optional[Dict[str, int]] = None,
+             compile_train: bool = True,
+             collect_targets: bool = False
+             ) -> Any:
+    """Run the selected engines; raw findings (baseline not applied).
+
+    ``collect_targets=True`` additionally returns the lowered targets
+    (graph + spmd) so a caller — the fingerprint gate — can reuse them
+    without paying the lowerings twice: ``(findings, targets)``.
+    """
     findings: List[Finding] = []
+    targets: List[Any] = []
     if ast:
         from raft_stereo_tpu.analysis.ast_rules import run_ast_rules
         root = package_root or os.path.join(REPO_ROOT, "raft_stereo_tpu")
         findings.extend(run_ast_rules(root))
     if graph:
-        from raft_stereo_tpu.analysis.graph_rules import run_graph_rules
-        findings.extend(run_graph_rules(thresholds=thresholds,
-                                        compile_train=compile_train))
-    return findings
+        from raft_stereo_tpu.analysis.graph_rules import (build_targets,
+                                                          run_graph_rules)
+        gt = build_targets(compile_train=compile_train)
+        findings.extend(run_graph_rules(thresholds=thresholds, targets=gt))
+        targets.extend(gt)
+    if spmd:
+        from raft_stereo_tpu.analysis.spmd_rules import (build_spmd_targets,
+                                                         ensure_host_devices,
+                                                         run_spmd_rules)
+        if ensure_host_devices():
+            st = build_spmd_targets(compile_programs=compile_train)
+            findings.extend(run_spmd_rules(thresholds=spmd_thresholds,
+                                           targets=st))
+            targets.extend(st)
+        else:
+            findings.append(Finding(
+                rule="spmd-skipped", severity="info", location="spmd",
+                message="SPMD engine skipped: the initialized backend "
+                        "cannot provide the 8-device mesh (run under "
+                        "JAX_PLATFORMS=cpu before jax initializes, or on "
+                        "a slice)"))
+    return (findings, targets) if collect_targets else findings
 
 
-def _rules_run(graph: bool, ast: bool) -> List[str]:
+def _rules_run(graph: bool, ast: bool, spmd: bool,
+               fingerprint: bool = False) -> List[str]:
     rules: List[str] = []
     if graph:
         from raft_stereo_tpu.analysis.graph_rules import GRAPH_RULES
         rules.extend(GRAPH_RULES)
     if ast:
-        rules.extend(["tracer-unsafe", "wall-clock", "import-time-jnp",
-                      "cli-drift"])
+        from raft_stereo_tpu.analysis.ast_rules import \
+            RULE_VERSIONS as ast_v
+        rules.extend(ast_v)
+    if spmd:
+        from raft_stereo_tpu.analysis.spmd_rules import SPMD_RULES
+        rules.extend(SPMD_RULES)
+    if fingerprint:
+        from raft_stereo_tpu.analysis.fingerprint import RULE
+        rules.append(RULE)
     return rules
 
 
@@ -68,7 +133,8 @@ def format_findings(findings: List[Finding],
         lines.append(f"{f.severity:7s} {f.rule:28s} {f.location}{mark}")
         lines.append(f"        {f.message}")
     for e in stale:
-        lines.append(f"stale   suppression matches nothing: "
+        reason = e.get("stale_reason", "matches nothing")
+        lines.append(f"stale   suppression ({reason}): "
                      f"{e['rule']} @ {e['location']}")
     unsup = severity_counts(findings, suppressed=False)
     sup = sum(1 for f in findings if f.suppressed)
@@ -78,23 +144,81 @@ def format_findings(findings: List[Finding],
     return "\n".join(lines)
 
 
+def _fingerprint_findings(args, targets: List[Any], partial: bool
+                          ) -> Tuple[List[Finding], Optional[Dict]]:
+    """The fingerprint leg of main(): compute/load the current doc, handle
+    ``--update-fingerprint``, diff against the baseline. Returns (findings,
+    current_doc); current_doc is None only on the precomputed-diff path."""
+    from raft_stereo_tpu.analysis.fingerprint import (compute_fingerprint,
+                                                      diff_fingerprint,
+                                                      load_fingerprint,
+                                                      write_fingerprint)
+    if args.fingerprint_current:
+        current = load_fingerprint(args.fingerprint_current)
+        partial = False
+    else:
+        current = compute_fingerprint(targets)
+    if args.update_fingerprint:
+        write_fingerprint(args.fingerprint_baseline, current)
+        print(f"fingerprint baseline rewritten: "
+              f"{args.fingerprint_baseline} "
+              f"({len(current['targets'])} target(s))")
+        return [], current
+    if not os.path.exists(args.fingerprint_baseline):
+        return [Finding(
+            rule="fingerprint-drift", severity="error",
+            location="fingerprint",
+            message=f"no fingerprint baseline at "
+                    f"{args.fingerprint_baseline} — generate one with "
+                    f"--update-fingerprint and check it in")], current
+    baseline = load_fingerprint(args.fingerprint_baseline)
+    return diff_fingerprint(baseline, current,
+                            peak_tolerance=args.fingerprint_tolerance,
+                            partial=partial), current
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="raft_stereo_tpu.cli lint",
-        description="graftlint: jaxpr/HLO contract checker + tracer-safety "
-                    "AST lint (see raft_stereo_tpu/analysis/)")
+        description="graftlint: jaxpr/HLO contract checker (single-device "
+                    "+ SPMD engines), tracer-safety AST lint, and the "
+                    "compiled-executable fingerprint gate (see "
+                    "raft_stereo_tpu/analysis/)")
     p.add_argument("--graph", action="store_true",
-                   help="run only the jaxpr/compiled-artifact rule engine")
+                   help="run only the unsharded jaxpr/compiled-artifact "
+                        "rule engine")
     p.add_argument("--ast", action="store_true",
                    help="run only the source AST lint")
+    p.add_argument("--spmd", action="store_true",
+                   help="run only the SPMD engine (sharded programs on the "
+                        "fake 8-device mesh)")
     p.add_argument("--no-compile", action="store_true",
-                   help="skip the donated train-step compile (faster; the "
-                        "donation rule needs the executable and is skipped)")
+                   help="skip the AOT compiles (faster; the donation/"
+                        "replication rules need executables and are "
+                        "skipped, and a fingerprint computed this way is "
+                        "partial)")
     p.add_argument("--baseline",
                    default=os.path.join(REPO_ROOT, ".graftlint.json"),
                    help="suppression baseline path")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline from current findings")
+    p.add_argument("--fingerprint", action="store_true",
+                   help="also diff the canonical executables' structural "
+                        "fingerprint against the checked-in baseline")
+    p.add_argument("--update-fingerprint", action="store_true",
+                   help="rewrite the fingerprint baseline from the current "
+                        "lowerings (implies --fingerprint)")
+    p.add_argument("--fingerprint-baseline",
+                   default=os.path.join(REPO_ROOT,
+                                        ".graftlint-fingerprint.json"),
+                   help="fingerprint baseline path")
+    p.add_argument("--fingerprint-tolerance", type=float, default=0.10,
+                   help="relative peak-bytes growth tolerated (default "
+                        "0.10)")
+    p.add_argument("--fingerprint-current", default=None,
+                   help="diff this precomputed fingerprint JSON instead of "
+                        "lowering anything (test/debug hook; skips every "
+                        "engine)")
     p.add_argument("--json", dest="json_out", default=None,
                    help="write the full JSON report here")
     p.add_argument("--run_dir", default=None,
@@ -105,18 +229,50 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "raft_stereo_tpu/ (fixture trees in tests)")
     args = p.parse_args(argv)
 
-    graph = args.graph or not args.ast
-    ast_on = args.ast or not args.graph
+    any_engine_flag = args.graph or args.ast or args.spmd
+    graph = args.graph or not any_engine_flag
+    ast_on = args.ast or not any_engine_flag
+    spmd_on = args.spmd or not any_engine_flag
+    fingerprint_on = (args.fingerprint or args.update_fingerprint
+                      or bool(args.fingerprint_current))
+    if args.fingerprint_current:
+        graph = ast_on = spmd_on = False
 
-    findings = run_lint(graph=graph, ast=ast_on,
-                        package_root=args.package_root,
-                        compile_train=not args.no_compile)
+    # the SPMD engine needs its virtual devices BEFORE any engine first
+    # imports jax (backends initialize once per process)
+    spmd_ready = True
+    if spmd_on:
+        from raft_stereo_tpu.analysis.spmd_rules import ensure_host_devices
+        spmd_ready = ensure_host_devices()
+
+    findings, targets = run_lint(
+        graph=graph, ast=ast_on, spmd=spmd_on,
+        package_root=args.package_root,
+        compile_train=not args.no_compile, collect_targets=True)
+
+    fp_doc = None
+    if fingerprint_on:
+        # a fingerprint over a subset of engines/compiles must not read a
+        # baseline-only target's absence as drift
+        partial = not (graph and spmd_on and spmd_ready) \
+            or args.no_compile
+        fp_findings, fp_doc = _fingerprint_findings(args, targets, partial)
+        findings.extend(fp_findings)
+        if args.update_fingerprint:
+            return 0
+
+    # staleness is validated against EVERY engine's rule map, not just the
+    # selected ones — a single-engine run must not declare the other
+    # engines' rules retired
+    versions = rule_versions()
     suppressions = load_baseline(args.baseline)
-    findings, stale = apply_baseline(findings, suppressions)
+    findings, stale = apply_baseline(findings, suppressions,
+                                     rule_versions=versions)
 
     if args.update_baseline:
         doc = baseline_from_findings(
-            [f for f in findings if f.severity == "error"])
+            [f for f in findings if f.severity == "error"],
+            rule_versions=versions)
         write_baseline(args.baseline, doc)
         print(f"baseline rewritten: {args.baseline} "
               f"({len(doc['suppressions'])} suppression(s))")
@@ -124,9 +280,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     print(format_findings(findings, stale))
 
-    engines = [e for e, on in (("graph", graph), ("ast", ast_on)) if on]
-    report = make_report(findings, _rules_run(graph, ast_on), engines,
+    engines = [e for e, on in (("graph", graph), ("ast", ast_on),
+                               ("spmd", spmd_on and spmd_ready),
+                               ("fingerprint", fingerprint_on)) if on]
+    report = make_report(findings, _rules_run(graph, ast_on, spmd_on,
+                                              fingerprint_on), engines,
                          stale_suppressions=stale)
+    if fp_doc is not None:
+        report["fingerprint"] = {"baseline": args.fingerprint_baseline,
+                                 "current": fp_doc}
     if args.json_out:
         os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
         with open(args.json_out, "w") as f:

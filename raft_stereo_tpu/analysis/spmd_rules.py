@@ -1,0 +1,524 @@
+"""SPMD contract rules over the canonical *sharded* lowerings (engine 3).
+
+The graph rules (graph_rules.py) police the single-device program; this
+engine polices what changes when a mesh appears: collective placement,
+sharding propagation, axis plumbing and donation *under SPMD partitioning*
+— the invariants that are silent on 1 device and ruinous on 8 (a psum
+inside the 22-iteration refinement scan pays ICI latency per iteration; a
+replicated B*H*W^2 correlation volume multiplies the dominant residency by
+the mesh size).
+
+Canonical sharded programs, lowered on a fake 8-device host mesh
+(``--xla_force_host_platform_device_count=8`` — same partitioner, same
+jaxpr topology as a TPU slice; only layouts differ):
+
+* ``train_step[dp]`` — the explicit shard_map DP step
+  (parallel/data_parallel.py) with psum'd gradients, AOT-compiled donated;
+* ``train_step[dp,batched]`` — the custom-VJP refinement scan + bf16
+  residual stacks under the same shard_map (jaxpr only);
+* ``inference[ring]`` — the dp x sp ring-correlation forward
+  (parallel/ring_corr.py) on a (data=2, seq=4) mesh, compiled.
+
+Rule ids: ``collective-in-loop`` (any collective inside a scan body is an
+error — the ring pipeline's block-rotation ppermute, recognized by
+structure via :func:`~raft_stereo_tpu.parallel.ring_corr.is_ring_perm`, is
+the one whitelisted shape), ``accidental-replication`` (a per-device
+buffer in the partitioned executable above the size threshold),
+``collective-dtype`` (fp32 reduction over values that were bf16 directly
+upstream — 2x the ICI bytes needed, warning), ``axis-leak`` (a mesh axis
+the target promises to reduce over that no collective touches, and axes
+bound but never used), ``donation-under-mesh`` (the donation contract
+re-checked on the sharded executable, where layout changes under
+partitioning are exactly what makes XLA drop aliasing silently).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from raft_stereo_tpu.analysis.findings import Finding
+
+#: current semantic version of every rule this engine exposes (suppression
+#: baseline entries carry the version they were written against; a bump
+#: flags them stale instead of silently matching a changed rule)
+RULE_VERSIONS: Dict[str, int] = {
+    "collective-in-loop": 1,
+    "accidental-replication": 1,
+    "collective-dtype": 1,
+    "axis-leak": 1,
+    "donation-under-mesh": 1,
+}
+
+DEFAULT_SPMD_THRESHOLDS: Dict[str, int] = {
+    # per-device buffer in the partitioned module above this = replication
+    # suspicion (the canonical targets' largest legitimate per-device
+    # activation is far below; a replicated volume lands far above)
+    "replicated_bytes": 8 << 20,          # 8 MiB
+    # collectives over fewer elements than this are metric/scalar glue, not
+    # an ICI bandwidth concern (the collective-dtype rule's floor)
+    "collective_min_elems": 1 << 10,
+    # at most this many accidental-replication findings per target (the
+    # largest ones; a systematically replicated graph would flood otherwise)
+    "replication_top": 4,
+}
+
+#: how many virtual host devices the canonical mesh needs
+MESH_DEVICES = 8
+
+
+def ensure_host_devices(n: int = MESH_DEVICES) -> bool:
+    """Make sure >= n devices exist, forcing a virtual host platform when
+    jax has not been imported yet (the ``cli lint`` path). Returns False
+    when the already-initialized backend cannot provide them — the caller
+    skips the engine instead of crashing the lint."""
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        import jax
+        # some sandbox images force-register an accelerator plugin at
+        # import; pin the analysis to the virtual host platform regardless
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import jax
+    try:
+        return len(jax.devices()) >= n
+    except Exception:
+        return False
+
+
+@dataclasses.dataclass
+class SpmdTarget:
+    """One sharded lowering under analysis."""
+
+    name: str
+    cfg: Any                        # RAFTStereoConfig
+    closed_jaxpr: Any               # jax.core.ClosedJaxpr
+    compiled: Any = None            # jax.stages.Compiled, when compiled
+    donate_declared: bool = False
+    platform: str = "cpu"
+    #: logical mesh (axis name -> size) the program was lowered for
+    mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: axes the target PROMISES at least one collective over (the DP step's
+    #: gradient psum, the ring's seq-axis rotation)
+    reduce_axes: Tuple[str, ...] = ()
+    _hlo_text: Optional[str] = dataclasses.field(default=None, repr=False)
+
+    def hlo_text(self) -> Optional[str]:
+        """Post-partitioning HLO of the compiled executable (cached); None
+        when uncompiled or the backend withholds it."""
+        if self._hlo_text is None and self.compiled is not None:
+            try:
+                self._hlo_text = self.compiled.as_text()
+            except Exception:
+                self._hlo_text = None
+        return self._hlo_text
+
+
+def _walk(target):
+    from raft_stereo_tpu.obs.xla import iter_eqns
+    return iter_eqns(target.closed_jaxpr, path=target.name)
+
+
+# --- rule: collective-in-loop ------------------------------------------------
+
+def rule_collective_in_loop(target: SpmdTarget,
+                            thresholds: Dict[str, int]) -> List[Finding]:
+    """A collective inside a scan body executes once per refinement
+    iteration, serialized against the loop's dependence chain — per-iter
+    ICI latency the serial-floor decomposition (PERF.md r7) says the model
+    cannot hide. The one legitimate shape is the ring-corr pipeline's block
+    rotation: a ppermute whose permutation is a pure ring
+    (parallel/ring_corr.py's structure tag)."""
+    from raft_stereo_tpu.obs.xla import COLLECTIVE_PRIMITIVES
+    from raft_stereo_tpu.parallel.ring_corr import is_ring_perm
+
+    hits: Dict[Tuple[str, str], int] = {}
+    whitelisted = 0
+    for eqn, path in _walk(target):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMITIVES or "/scan[" not in path:
+            continue
+        if name == "ppermute" and is_ring_perm(eqn.params.get("perm", ())):
+            whitelisted += 1
+            continue
+        key = (path, name)
+        hits[key] = hits.get(key, 0) + 1
+    return [Finding(
+        rule="collective-in-loop", severity="error", location=path,
+        message=f"{n} `{prim}` op(s) inside the scan body — a collective "
+                f"per refinement iteration rides the loop's serial "
+                f"dependence chain (only the ring-corr block rotation is "
+                f"whitelisted, by its permutation structure)",
+        data={"primitive": prim, "count": n,
+              "whitelisted_ring_ppermutes": whitelisted})
+        for (path, prim), n in sorted(hits.items())]
+
+
+# --- rule: accidental-replication --------------------------------------------
+
+def rule_accidental_replication(target: SpmdTarget,
+                                thresholds: Dict[str, int]) -> List[Finding]:
+    """After SPMD partitioning the module's shapes are per-device: any
+    buffer above the threshold is a tensor sharding propagation decided to
+    materialize (near-)unsharded on every device. The canonical catch is
+    the B*H*W^2 correlation volume going replicated — the single residency
+    that caps batch and resolution, silently multiplied by mesh size."""
+    text = target.hlo_text()
+    if text is None:
+        return []
+    from raft_stereo_tpu.obs.xla import hlo_large_instructions
+    hits = hlo_large_instructions(text, thresholds["replicated_bytes"],
+                                  top=thresholds["replication_top"])
+    findings: List[Finding] = []
+    for i, ins in enumerate(hits):
+        findings.append(Finding(
+            rule="accidental-replication", severity="error",
+            location=f"{target.name}/hlo/{ins['op']}[{i}]",
+            message=f"per-device buffer of {ins['bytes']} bytes "
+                    f"({ins['dtype']}{ins['shape']} from `{ins['op']}`) "
+                    f"exceeds the {thresholds['replicated_bytes']}-byte "
+                    f"replication threshold — sharding propagation "
+                    f"materialized an (effectively) unsharded tensor on "
+                    f"every device",
+            data={"bytes": ins["bytes"], "shape": ins["shape"],
+                  "dtype": ins["dtype"], "op": ins["op"],
+                  "instruction": ins["name"],
+                  "threshold": thresholds["replicated_bytes"]}))
+    return findings
+
+
+# --- rule: collective-dtype --------------------------------------------------
+
+_REDUCING_COLLECTIVES = ("psum", "psum2", "all_gather", "reduce_scatter",
+                         "psum_scatter", "all_to_all")
+
+
+def rule_collective_dtype(target: SpmdTarget,
+                          thresholds: Dict[str, int]) -> List[Finding]:
+    """An fp32 collective over a value that was bf16 immediately upstream
+    moves twice the ICI bytes the information needs: reduce in bf16 (or
+    widen after the collective) instead. Warning — fp32 accumulation across
+    many shards is sometimes a deliberate precision choice."""
+    import numpy as np
+
+    min_elems = thresholds["collective_min_elems"]
+    f32 = np.dtype("float32")
+    try:
+        import ml_dtypes
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+    except Exception:  # no bf16 on this install: nothing to compare against
+        return []
+    hits: Dict[Tuple[str, str], Dict[str, int]] = {}
+    producers: Dict[int, Any] = {}
+    for eqn, path in _walk(target):
+        if eqn.primitive.name == "convert_element_type":
+            producers[id(eqn.outvars[0])] = eqn
+        if eqn.primitive.name not in _REDUCING_COLLECTIVES:
+            continue
+        for iv in eqn.invars:
+            aval = getattr(iv, "aval", None)
+            if aval is None or getattr(aval, "dtype", None) != f32 \
+                    or aval.size < min_elems:
+                continue
+            prev = producers.get(id(iv))
+            if prev is None:
+                continue
+            src = getattr(prev.invars[0], "aval", None)
+            if src is not None and src.dtype == bf16:
+                key = (path, eqn.primitive.name)
+                rec = hits.setdefault(key, {"count": 0, "elems": 0})
+                rec["count"] += 1
+                rec["elems"] += int(aval.size)
+    return [Finding(
+        rule="collective-dtype", severity="warning", location=path,
+        message=f"fp32 `{prim}` over {rec['elems']} element(s) widened "
+                f"from bf16 immediately upstream — the reduction moves "
+                f"2x the ICI bytes the values carry; psum in bf16 or "
+                f"narrow before the collective",
+        data={"primitive": prim, **rec})
+        for (path, prim), rec in sorted(hits.items())]
+
+
+# --- rule: axis-leak ---------------------------------------------------------
+
+def _shard_map_bindings(target) -> List[Dict[str, Any]]:
+    """Per shard_map eqn: bound mesh axes/sizes, axes used by in/out specs,
+    and collective axes inside the body."""
+    from raft_stereo_tpu.obs.xla import (COLLECTIVE_PRIMITIVES,
+                                         collective_axis_names, iter_eqns,
+                                         iter_subjaxprs)
+
+    out: List[Dict[str, Any]] = []
+    for eqn, path in _walk(target):
+        if eqn.primitive.name != "shard_map":
+            continue
+        p = eqn.params
+        mesh = p.get("mesh")
+        axis_sizes: Dict[str, int] = {}
+        if mesh is not None:
+            try:
+                axis_sizes = dict(mesh.shape)
+            except Exception:
+                axis_sizes = {a: int(s) for a, s in
+                              zip(getattr(mesh, "axis_names", ()),
+                                  getattr(mesh, "axis_sizes", ()))}
+        spec_axes: set = set()
+        for names in (p.get("in_names") or ()) + (p.get("out_names") or ()):
+            if isinstance(names, dict):
+                for axes in names.values():
+                    spec_axes.update(a for a in axes if isinstance(a, str))
+        coll_axes: set = set()
+        for sub in iter_subjaxprs(p):
+            for seqn, _ in iter_eqns(sub, path=path):
+                if seqn.primitive.name in COLLECTIVE_PRIMITIVES:
+                    coll_axes.update(collective_axis_names(seqn))
+        out.append({"path": path, "axis_sizes": axis_sizes,
+                    "spec_axes": spec_axes, "collective_axes": coll_axes})
+    return out
+
+
+def rule_axis_leak(target: SpmdTarget,
+                   thresholds: Dict[str, int]) -> List[Finding]:
+    """Axis-name plumbing bugs: a target that promises a reduction over an
+    axis (the DP step's gradient psum over ``data``, the ring's rotation
+    over ``seq``) but whose lowering never runs a collective over it —
+    per-shard results silently diverge, which on a mesh means every device
+    trains on 1/n-th of the batch and believes it. Secondarily, an axis
+    bound by shard_map that neither any spec nor any collective references
+    is dead plumbing (warning)."""
+    bindings = _shard_map_bindings(target)
+    findings: List[Finding] = []
+    if target.reduce_axes and not bindings:
+        return [Finding(
+            rule="axis-leak", severity="error", location=target.name,
+            message="target declares reduce axes "
+                    f"{list(target.reduce_axes)} but its lowering contains "
+                    f"no shard_map at all — the program is not sharded",
+            data={"reduce_axes": list(target.reduce_axes)})]
+    all_coll = set().union(*(b["collective_axes"] for b in bindings)) \
+        if bindings else set()
+    sizes: Dict[str, int] = {}
+    for b in bindings:
+        sizes.update(b["axis_sizes"])
+    sizes.update({a: s for a, s in target.mesh_shape.items()
+                  if a not in sizes})
+    for axis in target.reduce_axes:
+        if sizes.get(axis, 0) > 1 and axis not in all_coll:
+            findings.append(Finding(
+                rule="axis-leak", severity="error", location=target.name,
+                message=f"axis {axis!r} (size {sizes[axis]}) must carry a "
+                        f"collective on this target but none reduces over "
+                        f"it — psum over the wrong axis, or a reduction "
+                        f"dropped: per-shard results never combine",
+                data={"axis": axis, "size": sizes[axis],
+                      "collective_axes": sorted(all_coll)}))
+    for b in bindings:
+        for axis, size in sorted(b["axis_sizes"].items()):
+            if size > 1 and axis not in b["spec_axes"] \
+                    and axis not in b["collective_axes"]:
+                findings.append(Finding(
+                    rule="axis-leak", severity="warning",
+                    location=f"{b['path']}/shard_map",
+                    message=f"mesh axis {axis!r} (size {size}) is bound by "
+                            f"shard_map but appears in no in/out spec and "
+                            f"no collective — dead axis plumbing",
+                    data={"axis": axis, "size": size}))
+    return findings
+
+
+# --- rule: donation-under-mesh -----------------------------------------------
+
+def rule_donation_under_mesh(target: SpmdTarget,
+                             thresholds: Dict[str, int]) -> List[Finding]:
+    """The unsharded donation rule re-run where it breaks most quietly:
+    partitioning changes layouts, and a layout mismatch is exactly what
+    makes XLA drop a declared donation — then every device double-buffers
+    the replicated train state."""
+    if target.compiled is None or not target.donate_declared:
+        return []
+    from raft_stereo_tpu.obs.xla import memory_analysis_dict
+    mem = memory_analysis_dict(target.compiled)
+    if mem is None:
+        return []
+    if mem.get("alias_bytes", 0) == 0:
+        return [Finding(
+            rule="donation-under-mesh", severity="error",
+            location=target.name,
+            message="donate_argnums declared but the SHARDED executable "
+                    "aliases 0 bytes — donation was dropped under the mesh "
+                    "and the replicated state is double-buffered on every "
+                    "device",
+            data={"argument_bytes": mem.get("argument_bytes", 0),
+                  "platform": target.platform,
+                  "mesh": dict(target.mesh_shape)})]
+    return []
+
+
+SPMD_RULES: Dict[str, Callable[[SpmdTarget, Dict[str, int]],
+                               List[Finding]]] = {
+    "collective-in-loop": rule_collective_in_loop,
+    "accidental-replication": rule_accidental_replication,
+    "collective-dtype": rule_collective_dtype,
+    "axis-leak": rule_axis_leak,
+    "donation-under-mesh": rule_donation_under_mesh,
+}
+
+
+def run_rules_on_target(target: SpmdTarget,
+                        thresholds: Optional[Dict[str, int]] = None
+                        ) -> List[Finding]:
+    th = dict(DEFAULT_SPMD_THRESHOLDS, **(thresholds or {}))
+    findings: List[Finding] = []
+    for fn in SPMD_RULES.values():
+        findings.extend(fn(target, th))
+    return findings
+
+
+# --- canonical sharded targets -----------------------------------------------
+
+def build_spmd_targets(batch: int = 8, h: int = 32, w: int = 48,
+                       iters: int = 3, ring_batch: int = 2,
+                       ring_w: int = 128, ring_iters: int = 2,
+                       compile_programs: bool = True) -> List[SpmdTarget]:
+    """Lower the canonical sharded programs on the fake 8-device mesh.
+
+    Same jaxpr topology as the production shapes — shape only enters aval
+    sizes, so collective placement/axis contracts checked here hold for the
+    TPU slice. ``ring_w`` satisfies the ring's width constraint at seq=4:
+    lcm(32, factor * seq * 2^(levels-1)) = 128.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+    from raft_stereo_tpu.models import create_model, init_model
+    from raft_stereo_tpu.parallel.data_parallel import (
+        make_shardmap_train_step)
+    from raft_stereo_tpu.parallel.mesh import (DATA_AXIS, SEQ_AXIS,
+                                               make_mesh, replicated)
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState
+
+    if len(jax.devices()) < MESH_DEVICES:
+        raise RuntimeError(
+            f"SPMD targets need {MESH_DEVICES} devices, have "
+            f"{len(jax.devices())} (force them with "
+            f"--xla_force_host_platform_device_count={MESH_DEVICES} before "
+            f"jax import, or call ensure_host_devices() first)")
+
+    platform = jax.default_backend()
+    base = RAFTStereoConfig()
+    model, variables = init_model(jax.random.PRNGKey(0), base, (1, h, w, 3))
+    tcfg = TrainConfig(batch_size=batch, train_iters=iters,
+                       image_size=(h, w))
+    tx = fetch_optimizer(tcfg)
+    state = TrainState.create(variables, tx)
+
+    rng = np.random.default_rng(0)
+
+    def batch_for(b, hh, ww):
+        return {
+            "image1": jnp.asarray(rng.uniform(0, 255, (b, hh, ww, 3)),
+                                  jnp.float32),
+            "image2": jnp.asarray(rng.uniform(0, 255, (b, hh, ww, 3)),
+                                  jnp.float32),
+            "flow": jnp.asarray(rng.uniform(-8, 0, (b, hh, ww, 1)),
+                                jnp.float32),
+            "valid": jnp.ones((b, hh, ww), jnp.float32),
+        }
+
+    targets: List[SpmdTarget] = []
+    batch_data = batch_for(batch, h, w)
+
+    # 1) explicit shard_map DP train step, compiled donated (the bench/DP
+    #    production recipe: fused in-scan loss, psum'd gradients)
+    mesh_dp = make_mesh(MESH_DEVICES, 1)
+    dp_step = make_shardmap_train_step(model, tx, iters, mesh_dp,
+                                       fused_loss=True)
+    dp_jaxpr = jax.make_jaxpr(lambda s, bd: dp_step(s, bd))(state,
+                                                            batch_data)
+    compiled = None
+    if compile_programs:
+        with mesh_dp:
+            state_r = jax.device_put(
+                jax.tree.map(jnp.array, state), replicated(mesh_dp))
+            dp_batch = {k: jax.device_put(
+                v, NamedSharding(mesh_dp, P(DATA_AXIS)))
+                for k, v in batch_data.items()}
+            compiled = dp_step.lower(state_r, dp_batch).compile()
+    targets.append(SpmdTarget(
+        name="train_step[dp]", cfg=base, closed_jaxpr=dp_jaxpr,
+        compiled=compiled, donate_declared=True, platform=platform,
+        mesh_shape={DATA_AXIS: MESH_DEVICES, SEQ_AXIS: 1},
+        reduce_axes=(DATA_AXIS,)))
+
+    # 2) the custom-VJP batched-weight-grad path under the same shard_map
+    #    (jaxpr only: placement/axis contracts; the unsharded wgrad pin
+    #    lives in graph_rules)
+    cfg_b = dataclasses.replace(base, batched_scan_wgrad=True,
+                                refinement_save_policy=False,
+                                residual_dtype="bfloat16")
+    model_b = create_model(cfg_b)
+    dp_step_b = make_shardmap_train_step(model_b, tx, iters, mesh_dp,
+                                         fused_loss=True)
+    targets.append(SpmdTarget(
+        name="train_step[dp,batched]", cfg=cfg_b,
+        closed_jaxpr=jax.make_jaxpr(
+            lambda s, bd: dp_step_b(s, bd))(state, batch_data),
+        platform=platform,
+        mesh_shape={DATA_AXIS: MESH_DEVICES, SEQ_AXIS: 1},
+        reduce_axes=(DATA_AXIS,)))
+
+    # 3) dp x sp ring-correlation inference on a (2, 4) mesh: the explicit
+    #    sequence-parallel path whose in-scan ppermute is the whitelist's
+    #    reason to exist
+    cfg_ring = dataclasses.replace(base, corr_implementation="ring")
+    model_ring = create_model(cfg_ring)
+    mesh_ring = make_mesh(2, 4)
+    ring_batch_data = batch_for(ring_batch, h, ring_w)
+
+    def infer(v, a, b):
+        return model_ring.apply(v, a, b, iters=ring_iters, test_mode=True)
+
+    with mesh_ring:
+        ring_jaxpr = jax.make_jaxpr(infer)(
+            variables, ring_batch_data["image1"], ring_batch_data["image2"])
+        compiled_ring = None
+        if compile_programs:
+            spec = NamedSharding(mesh_ring, P(DATA_AXIS, None, SEQ_AXIS,
+                                              None))
+            im1 = jax.device_put(ring_batch_data["image1"], spec)
+            im2 = jax.device_put(ring_batch_data["image2"], spec)
+            compiled_ring = jax.jit(infer).lower(variables, im1,
+                                                 im2).compile()
+    targets.append(SpmdTarget(
+        name="inference[ring]", cfg=cfg_ring, closed_jaxpr=ring_jaxpr,
+        compiled=compiled_ring, platform=platform,
+        mesh_shape={DATA_AXIS: 2, SEQ_AXIS: 4},
+        reduce_axes=(SEQ_AXIS,)))
+    return targets
+
+
+def run_spmd_rules(thresholds: Optional[Dict[str, int]] = None,
+                   compile_programs: bool = True,
+                   targets: Optional[List[SpmdTarget]] = None
+                   ) -> List[Finding]:
+    """Build the canonical sharded targets (unless given) and run every
+    SPMD rule."""
+    if targets is None:
+        targets = build_spmd_targets(compile_programs=compile_programs)
+    findings: List[Finding] = []
+    for t in targets:
+        findings.extend(run_rules_on_target(t, thresholds))
+    return findings

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 from raft_stereo_tpu.obs.events import read_events
@@ -200,7 +201,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help=f"relative compile-time growth tolerated "
                         f"(default {DEFAULT_THRESHOLDS['compile_growth']})")
     p.add_argument("--json", default=None,
-                   help="also write the full report to this path")
+                   help="write the full report to this path; '-' prints "
+                        "the report JSON to stdout INSTEAD of the text "
+                        "table (machine consumers — rehearse_round's "
+                        "compare leg — parse this rather than scraping "
+                        "the rendering)")
     args = p.parse_args(argv)
     report = compare_runs(args.baseline, args.candidate, thresholds={
         "throughput_drop": args.max_throughput_drop,
@@ -208,11 +213,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "memory_growth": args.max_memory_growth,
         "compile_growth": args.max_compile_growth,
     })
-    if args.json:
-        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
-        with open(args.json, "w") as f:
-            json.dump(report, f, indent=1)
-    print(format_comparison(report))
+    if args.json == "-":
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        if args.json:
+            os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=1)
+        print(format_comparison(report))
     if report.get("error"):
         return 2
     return 0 if report["ok"] else 1

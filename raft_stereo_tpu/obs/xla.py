@@ -275,6 +275,207 @@ def emit_op_counts(profile: Dict[str, Any], telemetry=None,
     return rec
 
 
+# --- collective walkers ------------------------------------------------------
+#
+# The SPMD engine (analysis/spmd_rules.py) and the fingerprint gate
+# (analysis/fingerprint.py) both ask the same two questions of a sharded
+# program: WHICH collectives does it run, and do any of them live inside the
+# refinement scan's loop body (executed per iteration, serialized against the
+# scan's dependence chain)? The jaxpr walk answers for the traced program;
+# the HLO walk answers for the compiled executable after SPMD partitioning,
+# where XLA's propagation may have inserted collectives the trace never wrote.
+
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+    "all_gather_invariant",
+    # shard_map's replication-rule rewrite (check_rep/check_vma=True)
+    # re-spells psum; pbroadcast is deliberately NOT here — it marks a
+    # replication-type change, no bytes move
+    "psum2",
+})
+
+
+def collective_axis_names(eqn) -> tuple:
+    """Mesh axis names a collective eqn operates over (``axes`` on the psum
+    family, ``axis_name`` on ppermute/all_gather; positional vmap axes are
+    dropped — only named mesh axes matter to the SPMD contracts)."""
+    p = eqn.params
+    v = p.get("axes", p.get("axis_name", ()))
+    if not isinstance(v, (tuple, list)):
+        v = (v,)
+    return tuple(a for a in v if isinstance(a, str))
+
+
+def collective_profile(closed_jaxpr, path: str = "top") -> Dict[str, Any]:
+    """Jaxpr-level collective placement profile.
+
+    Returns ``{"total", "by_kind": {prim: n}, "in_loop": {prim: n},
+    "outside": {prim: n}, "axes": {prim: [axis...]}}`` where ``in_loop``
+    counts collectives whose walk path crosses a scan body — the ones a
+    sharded program executes once per loop iteration.
+    """
+    by_kind: Dict[str, int] = {}
+    in_loop: Dict[str, int] = {}
+    outside: Dict[str, int] = {}
+    axes: Dict[str, set] = {}
+    for eqn, epath in iter_eqns(closed_jaxpr, path=path):
+        name = eqn.primitive.name
+        if name not in COLLECTIVE_PRIMITIVES:
+            continue
+        by_kind[name] = by_kind.get(name, 0) + 1
+        bucket = in_loop if "/scan[" in epath else outside
+        bucket[name] = bucket.get(name, 0) + 1
+        axes.setdefault(name, set()).update(collective_axis_names(eqn))
+    return {"total": sum(by_kind.values()), "by_kind": by_kind,
+            "in_loop": in_loop, "outside": outside,
+            "axes": {k: sorted(v) for k, v in axes.items()}}
+
+
+# Optimized-HLO line shapes (any backend, post SPMD partitioning):
+#   %all-reduce.1 = f32[128]{0} all-reduce(f32[128]{0} %x), replica_groups=...
+#   ROOT %tuple.2 = (f32[2,8,12,64]{...}) tuple(%y)
+#   %while.3 = (...) while(%t), condition=%cond.1, body=%body.1
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*([a-z0-9]+)\[([\d,]*)\]"
+    r"(?:\{[^}]*\})?\s+([\w\-]+)\(")
+# tuple-typed instructions (while/optimization-barrier/...): no single array
+# shape; still needed for the call graph (a while's body= edge lives here)
+_HLO_TUPLE_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*\(.*?\)\s+([\w\-]+)\(")
+# computation header: `%region_0.12_spmd (param: (s32[], f32[1,16])) ->
+# (s32[], f32[1,16]) {` — the param list nests parens, so the name is
+# matched alone and the header shape (`... -> ... {`, no `=` before the
+# params) is checked separately in parse_hlo_instructions
+_HLO_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
+# called-computation attrs: either a single ref (`body=%region_0.12`) or a
+# braced list (`branch_computations={%a, %b}`); an unanchored comma-list
+# would swallow the NEXT attr's key (`condition=%x, body=%y` -> "x, body")
+_HLO_CALLED_RE = re.compile(
+    r"(?:body|condition|calls|to_apply|branch_computations)="
+    r"(\{[^}]*\}|%?[\w.\-]+)")
+
+HLO_COLLECTIVE_OPS = ("all-reduce", "all-gather", "collective-permute",
+                      "all-to-all", "reduce-scatter", "collective-broadcast",
+                      "all-reduce-start", "all-gather-start",
+                      "collective-permute-start")
+
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1, "s4": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1, "u4": 1,
+    "pred": 1, "c64": 8, "c128": 16,
+}
+
+
+def _hlo_bytes(dtype: str, dims: str) -> Optional[int]:
+    itemsize = _HLO_DTYPE_BYTES.get(dtype)
+    if itemsize is None:
+        return None
+    n = 1
+    for d in filter(None, dims.split(",")):
+        n *= int(d)
+    return n * itemsize
+
+
+def parse_hlo_instructions(hlo_text: str) -> List[Dict[str, Any]]:
+    """Flat instruction list from an HLO module text: ``{"name", "op",
+    "dtype", "shape", "bytes", "computation", "called"}`` per array-typed
+    instruction (tuple-typed aggregates — while/parameter tuples — are
+    skipped; their leaves appear individually)."""
+    out: List[Dict[str, Any]] = []
+    comp = "<module>"
+    for line in hlo_text.splitlines():
+        stripped = line.rstrip()
+        if stripped.endswith("{") and "->" in stripped \
+                and "=" not in stripped.split("(", 1)[0]:
+            mc = _HLO_COMP_RE.match(line)
+            if mc:
+                comp = mc.group(1)
+                continue
+        m = _HLO_INSTR_RE.match(line)
+        if m:
+            name, dtype, dims, op = m.groups()
+        else:
+            mt = _HLO_TUPLE_INSTR_RE.match(line)
+            if not mt:
+                continue
+            name, op = mt.groups()
+            dtype, dims = None, None
+        called: List[str] = []
+        for mm in _HLO_CALLED_RE.finditer(line):
+            called.extend(c.strip().lstrip("%")
+                          for c in mm.group(1).strip("{}").split(",")
+                          if c.strip())
+        out.append({"name": name, "op": op, "dtype": dtype,
+                    "shape": ([int(d) for d in filter(None, dims.split(","))]
+                              if dims is not None else None),
+                    "bytes": (_hlo_bytes(dtype, dims)
+                              if dtype is not None else None),
+                    "computation": comp, "called": called})
+    return out
+
+
+def hlo_collective_profile(hlo_text: str) -> Dict[str, Any]:
+    """Collectives in a compiled (post-partitioning) HLO module.
+
+    Returns ``{"total", "by_kind": {op: n}, "in_loop": {op: n}}`` where
+    ``in_loop`` counts collectives living in a computation reachable from a
+    ``while`` op's body — the compiled mirror of
+    :func:`collective_profile`'s scan-body bucket.
+    """
+    instrs = parse_hlo_instructions(hlo_text)
+    # computation -> computations it calls (one edge set; whiles contribute
+    # their body+condition, fusions/calls their callees)
+    edges: Dict[str, set] = {}
+    loop_roots: set = set()
+    for ins in instrs:
+        if ins["called"]:
+            edges.setdefault(ins["computation"], set()).update(ins["called"])
+        if ins["op"] == "while":
+            loop_roots.update(ins["called"])
+    in_loop_comps: set = set()
+    frontier = set(loop_roots)
+    while frontier:
+        nxt = set()
+        for c in frontier:
+            if c in in_loop_comps:
+                continue
+            in_loop_comps.add(c)
+            nxt.update(edges.get(c, ()))
+        frontier = nxt - in_loop_comps
+    by_kind: Dict[str, int] = {}
+    in_loop: Dict[str, int] = {}
+    for ins in instrs:
+        if ins["op"] not in HLO_COLLECTIVE_OPS:
+            continue
+        op = ins["op"].replace("-start", "")
+        by_kind[op] = by_kind.get(op, 0) + 1
+        if ins["computation"] in in_loop_comps:
+            in_loop[op] = in_loop.get(op, 0) + 1
+    return {"total": sum(by_kind.values()), "by_kind": by_kind,
+            "in_loop": in_loop}
+
+
+# Aggregate/bookkeeping ops whose "output" is an alias or an input copy, not
+# a buffer the partitioner materialized.
+_HLO_NONMATERIALIZING = frozenset({
+    "parameter", "get-tuple-element", "tuple", "bitcast", "constant",
+})
+
+
+def hlo_large_instructions(hlo_text: str, min_bytes: int,
+                           top: int = 8) -> List[Dict[str, Any]]:
+    """Array-materializing instructions whose per-device output buffer is at
+    least ``min_bytes``, largest first — in a post-partitioning module these
+    are the tensors each device actually holds, so a "sharded" intermediate
+    showing up here at its full global size is replication made visible."""
+    hits = [ins for ins in parse_hlo_instructions(hlo_text)
+            if ins["bytes"] is not None and ins["bytes"] >= min_bytes
+            and ins["op"] not in _HLO_NONMATERIALIZING]
+    return sorted(hits, key=lambda i: -i["bytes"])[:top]
+
+
 # --- buffer-assignment dumps ------------------------------------------------
 #
 # Line shapes in an XLA *buffer-assignment.txt (any backend):

@@ -50,6 +50,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_stereo_tpu.ops.pallas.corr_kernels import _interpret
 
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; support both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 # VMEM working-set budget per grid program (volume slabs + activations).
 _VMEM_BUDGET = 32 * 1024 * 1024
 
@@ -243,7 +247,7 @@ def _flc_fwd(levels, coords_x, kernel, bias, radius, dt):
         + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
         out_specs=blk(co),
         out_shape=jax.ShapeDtypeStruct((b, h * w, co), dt),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_interpret(),
     )(coords_f, *levels_f, kernel, bias2)
@@ -281,7 +285,7 @@ def _flc_bwd(radius, dt, res, g):
                    for x in w2s]
         + [jax.ShapeDtypeStruct(kernel.shape, jnp.float32),
            jax.ShapeDtypeStruct((1, co), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=_interpret(),
     )(coords_f, *levels_f, g_f, kernel, bias2)

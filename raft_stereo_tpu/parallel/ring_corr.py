@@ -42,6 +42,41 @@ from raft_stereo_tpu.ops.sampler import windowed_linear_sample
 from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
 
 
+def ring_perm(n: int):
+    """The ring pipeline's block rotation: device k hands its block to k+1.
+
+    This is the structural signature the SPMD lint keys its whitelist off
+    (:func:`is_ring_perm`): a ``ppermute`` with exactly this shape inside
+    the refinement scan body is the ring-corr pipeline doing its job, while
+    any other collective there is a placement bug.
+    """
+    return [(k, (k + 1) % n) for k in range(n)]
+
+
+def is_ring_perm(perm) -> bool:
+    """True when ``perm`` is a pure ring rotation over all n participants
+    (every source present once, one constant non-zero step).
+
+    Shared structure tag between :func:`ring_corr_lookup` (which builds its
+    permutation through :func:`ring_perm`) and the ``collective-in-loop``
+    SPMD rule (analysis/spmd_rules.py), so the whitelist cannot drift from
+    the implementation: a ppermute that stops matching this shape loses its
+    exemption in the same commit that changes it.
+    """
+    try:
+        pairs = [(int(a), int(b)) for a, b in perm]
+    except (TypeError, ValueError):
+        return False
+    n = len(pairs)
+    if n < 2 or sorted(a for a, _ in pairs) != list(range(n)) \
+            or sorted(b for _, b in pairs) != list(range(n)):
+        return False
+    step = (pairs[0][1] - pairs[0][0]) % n
+    if step == 0:
+        return False
+    return all((b - a) % n == step for a, b in pairs)
+
+
 def ring_corr_lookup(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
                      *, radius: int = 4, num_levels: int = 4,
                      axis_name: str = SEQ_AXIS) -> jax.Array:
@@ -89,9 +124,7 @@ def ring_corr_lookup(fmap1: jax.Array, fmap2: jax.Array, coords: jax.Array,
         partial = jnp.concatenate(contrib, axis=-1)
         out = partial if out is None else out + partial
         if step + 1 < n:
-            block = jax.lax.ppermute(
-                block, axis_name,
-                perm=[(k, (k + 1) % n) for k in range(n)])
+            block = jax.lax.ppermute(block, axis_name, perm=ring_perm(n))
     return out
 
 

@@ -36,17 +36,26 @@ rehearsal:
 * **lint** — graftlint (r9): ``python -m raft_stereo_tpu.cli lint`` under
   ``JAX_PLATFORMS=cpu`` — the jaxpr/compiled-artifact contract rules
   (wgrad placement, dtype policy, donation, host-sync, carry/constant
-  size) plus the tracer-safety AST lint, gated on unsuppressed
-  error-severity findings against the checked-in ``.graftlint.json``
-  baseline. A structural regression in the hot path fails the rehearsal
-  even when every numeric test still passes.
+  size), the SPMD engine (collective placement / sharding-propagation /
+  axis / mesh-donation contracts on the fake 8-device mesh, r10) and the
+  tracer-safety AST lint, gated on unsuppressed error-severity findings
+  against the checked-in ``.graftlint.json`` baseline. A structural
+  regression in the hot path fails the rehearsal even when every numeric
+  test still passes.
+* **fingerprint** — the structural regression gate (r10): ``cli lint
+  --fingerprint`` diffs the canonical executables' checked-in fingerprint
+  (``.graftlint-fingerprint.json``: conv placement, collective
+  kinds/counts in- and out-of-loop, peak bytes, donation pairs) against
+  HEAD's lowerings — a new collective, a wgrad conv re-entering the
+  backward loop or a >10% peak-bytes jump fails the leg; intentional
+  structural changes re-bank with ``--update-fingerprint``.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
 the rehearsal can gate a round's end ritual.
 
 Run: python scripts/rehearse_round.py
-     [--legs bench multichip events compare scangrad lint]
+     [--legs bench multichip events compare scangrad lint fingerprint]
      [--bench-budget S] [--multichip-budget S] [--baseline RUN_DIR]
 """
 
@@ -141,18 +150,40 @@ def check_event_artifacts(paths):
 
 
 def compare_leg(baseline, candidate, timeout_s=300.0):
-    """The regression-gate leg; skip-ok while either run dir is absent."""
+    """The regression-gate leg; skip-ok while either run dir is absent.
+
+    Consumes the gate's machine report (``cli compare --json``) rather than
+    scraping the text table: the rehearsal record carries the actual
+    regression list and per-metric verdicts, so a failed leg says WHICH
+    metric moved — and by how much — without re-running the comparison."""
     missing = [d for d in (baseline, candidate)
                if not os.path.exists(os.path.join(d, "events.jsonl"))]
     if missing:
         return {"leg": "compare", "ok": True, "skipped": True,
                 "error": None, "baseline": baseline, "candidate": candidate,
                 "note": f"no events.jsonl under {missing} — gate skipped"}
+    report_path = os.path.join(REPO, "runs", "rehearsal_compare.json")
     rec = run_leg("compare",
                   [sys.executable, "-m", "raft_stereo_tpu.cli", "compare",
-                   baseline, candidate],
+                   baseline, candidate, "--json", report_path],
                   timeout_s)
     rec.update(baseline=baseline, candidate=candidate)
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        rec["ok"] = False
+        rec["error"] = f"no readable JSON report at {report_path}: {e}"
+        return rec
+    rec["regressions"] = report.get("regressions", [])
+    rec["metrics"] = {
+        name: {"baseline": m["baseline"], "candidate": m["candidate"],
+               "regression_rel": m["regression_rel"]}
+        for name, m in report.get("metrics", {}).items()}
+    if not rec["ok"] and rec["regressions"]:
+        rec["error"] = "regressions: " + ", ".join(rec["regressions"])
+    elif not rec["ok"] and report.get("error"):
+        rec["error"] = report["error"]
     return rec
 
 
@@ -162,11 +193,12 @@ def main(argv=None):
                     "driver's budgets (see module doc)")
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint"],
+                            "scangrad", "lint", "fingerprint"],
                    choices=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint"])
+                            "scangrad", "lint", "fingerprint"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
+    p.add_argument("--fingerprint-budget", type=float, default=900.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -210,6 +242,12 @@ def main(argv=None):
         records.append(run_leg(
             "lint", [sys.executable, "-m", "raft_stereo_tpu.cli", "lint"],
             args.lint_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "fingerprint" in args.legs:
+        records.append(run_leg(
+            "fingerprint",
+            [sys.executable, "-m", "raft_stereo_tpu.cli", "lint",
+             "--fingerprint"],
+            args.fingerprint_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

@@ -388,18 +388,22 @@ def test_runner_gates_on_seeded_violation(tmp_path, capsys):
 # ----------------------------------------------------------- clean tree
 
 def test_head_passes_cli_lint(capsys):
-    """The acceptance criterion: `cli lint` (both engines over the real
-    package — canonical graph targets lowered at the tiny shape) runs
+    """The acceptance criterion: `cli lint` (graph + ast engines over the
+    real package — canonical graph targets lowered at the tiny shape) runs
     green on HEAD: zero unsuppressed error-severity findings.
 
     ``--no-compile`` keeps the tier-1 budget: it skips only the donated
     AOT compile of the train step (the donation rule itself is pinned
     above on compiled fixtures, and scripts/rehearse_round.py's `lint`
     leg runs the full compile path every round — green run on record in
-    runs/rehearsal.log)."""
+    runs/rehearsal.log). ``--graph --ast`` keeps the SPMD engine out for
+    the same reason — conftest's 8 virtual devices would let it trace the
+    three full-model sharded programs here (~12 s); that clean-tree
+    guarantee lives in test_spmd_lint's slow-marked
+    test_head_passes_spmd_rules_jaxpr_only and in the rehearsal legs."""
     from raft_stereo_tpu.analysis.runner import main as lint_main
 
-    rc = lint_main(["--no-compile"])
+    rc = lint_main(["--no-compile", "--graph", "--ast"])
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "0 error(s)" in out

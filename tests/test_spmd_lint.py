@@ -1,0 +1,564 @@
+"""graftlint engine 3 (analysis/spmd_rules) + the fingerprint gate
+(analysis/fingerprint): every SPMD rule fires on a seeded minimal
+violation under the conftest's fake 8-device CPU mesh, the ring-corr
+ppermute whitelist keys off the shared structure tag, fingerprint diffs
+catch each drift class, and an injected structural regression flips
+``cli lint`` to exit 1.
+
+Fixtures are tiny synthetic shard_map programs (not the full model) so
+each rule's trigger condition is explicit; the model-scale sharded path is
+covered by the clean-tree test at the bottom (which lowers the real
+canonical targets jaxpr-only) and by rehearse_round's lint/fingerprint
+legs running the full compiled path every round.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_stereo_tpu.analysis import fingerprint as fp
+from raft_stereo_tpu.analysis.findings import Finding, apply_baseline
+from raft_stereo_tpu.analysis.spmd_rules import (DEFAULT_SPMD_THRESHOLDS,
+                                                 SpmdTarget,
+                                                 rule_accidental_replication,
+                                                 rule_axis_leak,
+                                                 rule_collective_dtype,
+                                                 rule_collective_in_loop,
+                                                 rule_donation_under_mesh)
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.parallel.compat import shard_map
+from raft_stereo_tpu.parallel.mesh import make_mesh
+from raft_stereo_tpu.parallel.ring_corr import is_ring_perm, ring_perm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def spmd_target(fn, *example_args, name="fixture", mesh_shape=None,
+                reduce_axes=(), **kw):
+    return SpmdTarget(name=name, cfg=RAFTStereoConfig(),
+                      closed_jaxpr=jax.make_jaxpr(fn)(*example_args),
+                      mesh_shape=mesh_shape or {},
+                      reduce_axes=reduce_axes, **kw)
+
+
+def th(**overrides):
+    return dict(DEFAULT_SPMD_THRESHOLDS, **overrides)
+
+
+# ------------------------------------------------------ collective-in-loop
+
+def test_psum_in_scan_body_fires():
+    """The canonical seeded violation: a psum injected into the scan body
+    = one collective per refinement iteration on the serial chain."""
+    mesh = make_mesh(8, 1)
+
+    def sharded(x):
+        def body(c, _):
+            return c + jax.lax.psum(c, "data"), None
+        c, _ = jax.lax.scan(body, x, None, length=3)
+        return c
+
+    f = shard_map(sharded, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"))
+    t = spmd_target(f, jnp.ones((8, 16)))
+    fs = rule_collective_in_loop(t, th())
+    assert len(fs) == 1
+    assert fs[0].severity == "error"
+    # shard_map's replication-rule rewrite spells the primitive psum2
+    assert fs[0].data["primitive"].startswith("psum")
+    assert "/scan[" in fs[0].location
+
+
+def test_psum_outside_scan_is_clean():
+    mesh = make_mesh(8, 1)
+
+    def sharded(x):
+        def body(c, _):
+            return c * 2, None
+        c, _ = jax.lax.scan(body, x, None, length=3)
+        return jax.lax.psum(c, "data")
+
+    f = shard_map(sharded, mesh=mesh, in_specs=P("data"), out_specs=P())
+    assert rule_collective_in_loop(spmd_target(f, jnp.ones((8, 16))),
+                                  th()) == []
+
+
+def test_ring_ppermute_whitelisted_but_non_ring_fires():
+    """The ring-corr block rotation keeps its in-loop exemption through the
+    shared structure tag; any other permutation in the same position loses
+    it."""
+    mesh = make_mesh(1, 8)
+
+    def make(perm):
+        def sharded(x):
+            def body(c, _):
+                return jax.lax.ppermute(c, "seq", perm=perm), None
+            c, _ = jax.lax.scan(body, x, None, length=3)
+            return c
+        return shard_map(sharded, mesh=mesh, in_specs=P(None, "seq"),
+                         out_specs=P(None, "seq"))
+
+    ring = spmd_target(make(ring_perm(8)), jnp.ones((4, 8)))
+    fs = rule_collective_in_loop(ring, th())
+    assert fs == []
+
+    swap = [(i, i ^ 1) for i in range(8)]       # pairwise swap: not a ring
+    broken = spmd_target(make(swap), jnp.ones((4, 8)))
+    fs = rule_collective_in_loop(broken, th())
+    assert len(fs) == 1 and fs[0].data["primitive"] == "ppermute"
+
+
+def test_is_ring_perm_structure_tag():
+    assert is_ring_perm(ring_perm(4))
+    assert is_ring_perm(ring_perm(8))
+    assert is_ring_perm([(k, (k + 3) % 8) for k in range(8)])  # stride ring
+    assert not is_ring_perm([(k, k) for k in range(4)])        # identity
+    assert not is_ring_perm([(0, 1), (1, 0), (2, 3), (3, 2)])  # swaps
+    assert not is_ring_perm([(0, 1), (1, 2)])                  # partial
+    assert not is_ring_perm([(0, 1)])                          # degenerate
+    assert not is_ring_perm("nonsense")
+
+
+# -------------------------------------------------- accidental-replication
+
+def test_replicated_volume_fires_sharded_is_clean():
+    """The hand-mis-sharded fixture: a correlation-shaped B*H*W*W einsum
+    whose inputs are replicated materializes the full volume on every
+    device; the same program with the batch sharded stays under the
+    per-device threshold."""
+    mesh = make_mesh(8, 1)
+
+    def volume(a, b):
+        v = jnp.einsum("bhwd,bhvd->bhwv", a, b,
+                       preferred_element_type=jnp.float32)
+        return v.sum()
+
+    a = np.ones((8, 16, 64, 8), np.float32)
+    threshold = th(replicated_bytes=1 << 20)    # 1 MiB
+
+    with mesh:
+        rep = jax.device_put(a, NamedSharding(mesh, P()))
+        compiled_rep = jax.jit(volume).lower(rep, rep).compile()
+        shd = jax.device_put(a, NamedSharding(mesh, P("data")))
+        compiled_shd = jax.jit(volume).lower(shd, shd).compile()
+
+    # full volume: 8*16*64*64 f32 = 2 MiB on EVERY device
+    t = SpmdTarget(name="rep", cfg=RAFTStereoConfig(), closed_jaxpr=None,
+                   compiled=compiled_rep)
+    fs = rule_accidental_replication(t, threshold)
+    assert fs and all(f.severity == "error" for f in fs)
+    assert max(f.data["bytes"] for f in fs) >= 8 * 16 * 64 * 64 * 4
+
+    # batch-sharded: 1/8th per device = 256 KiB, under the threshold
+    t = SpmdTarget(name="shd", cfg=RAFTStereoConfig(), closed_jaxpr=None,
+                   compiled=compiled_shd)
+    assert rule_accidental_replication(t, threshold) == []
+
+
+# -------------------------------------------------------- collective-dtype
+
+def test_fp32_psum_over_upcast_bf16_warns():
+    mesh = make_mesh(8, 1)
+
+    def widened(x):
+        return jax.lax.psum(x.astype(jnp.float32), "data")
+
+    f = shard_map(widened, mesh=mesh, in_specs=P("data"), out_specs=P())
+    t = spmd_target(f, jnp.ones((8, 2048), jnp.bfloat16))
+    fs = rule_collective_dtype(t, th())
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert fs[0].data["elems"] >= 2048
+
+    def native(x):                               # bf16 psum: clean
+        return jax.lax.psum(x, "data")
+
+    f = shard_map(native, mesh=mesh, in_specs=P("data"), out_specs=P())
+    assert rule_collective_dtype(
+        spmd_target(f, jnp.ones((8, 2048), jnp.bfloat16)), th()) == []
+
+    def small(x):                                # scalar glue: under floor
+        return jax.lax.psum(x.astype(jnp.float32), "data")
+
+    f = shard_map(small, mesh=mesh, in_specs=P("data"), out_specs=P())
+    assert rule_collective_dtype(
+        spmd_target(f, jnp.ones((8, 4), jnp.bfloat16)), th()) == []
+
+
+# --------------------------------------------------------------- axis-leak
+
+def test_promised_reduction_missing_fires():
+    """The dropped-psum seed: a DP step whose gradient reduction vanished
+    — every device would train on 1/8th of the batch and believe it."""
+    mesh = make_mesh(8, 1)
+
+    f = shard_map(lambda x: x * 2, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"))
+    t = spmd_target(f, jnp.ones((8, 16)),
+                    mesh_shape={"data": 8, "seq": 1},
+                    reduce_axes=("data",))
+    fs = rule_axis_leak(t, th())
+    errors = [f for f in fs if f.severity == "error"]
+    assert len(errors) == 1 and errors[0].data["axis"] == "data"
+
+    def reduced(x):
+        return jax.lax.psum(x, "data")
+
+    f = shard_map(reduced, mesh=mesh, in_specs=P("data"), out_specs=P())
+    t = spmd_target(f, jnp.ones((8, 16)),
+                    mesh_shape={"data": 8, "seq": 1},
+                    reduce_axes=("data",))
+    assert [f for f in rule_axis_leak(t, th())
+            if f.severity == "error"] == []
+
+
+def test_unsharded_program_with_promise_fires():
+    t = spmd_target(lambda x: x * 2, jnp.ones((8, 16)),
+                    mesh_shape={"data": 8}, reduce_axes=("data",))
+    fs = rule_axis_leak(t, th())
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "no shard_map" in fs[0].message
+
+
+def test_dead_axis_plumbing_warns():
+    mesh = make_mesh(4, 2)
+
+    f = shard_map(lambda x: x * 2, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"))
+    fs = rule_axis_leak(spmd_target(f, jnp.ones((8, 16))), th())
+    warns = [f for f in fs if f.severity == "warning"]
+    assert len(warns) == 1 and warns[0].data["axis"] == "seq"
+
+
+# ------------------------------------------------------ donation-under-mesh
+
+def test_dropped_mesh_donation_fires():
+    mesh = make_mesh(8, 1)
+
+    def step(state, x):
+        return jax.tree.map(lambda a: a + x.sum(), state)
+
+    f = shard_map(step, mesh=mesh, in_specs=(P(), P("data")),
+                  out_specs=P(), check_vma=False)
+    state = {"p": jnp.zeros((256, 256))}
+    x = jnp.ones((8, 16))
+    with mesh:
+        donated = jax.jit(f, donate_argnums=(0,)).lower(state, x).compile()
+        dropped = jax.jit(f).lower(state, x).compile()
+
+    ok = SpmdTarget(name="t", cfg=RAFTStereoConfig(), closed_jaxpr=None,
+                    compiled=donated, donate_declared=True,
+                    mesh_shape={"data": 8})
+    assert rule_donation_under_mesh(ok, th()) == []
+
+    broken = SpmdTarget(name="t", cfg=RAFTStereoConfig(), closed_jaxpr=None,
+                        compiled=dropped, donate_declared=True,
+                        mesh_shape={"data": 8})
+    fs = rule_donation_under_mesh(broken, th())
+    assert [f.severity for f in fs] == ["error"]
+    assert "aliases 0 bytes" in fs[0].message
+
+    undeclared = SpmdTarget(name="t", cfg=RAFTStereoConfig(),
+                            closed_jaxpr=None, compiled=dropped)
+    assert rule_donation_under_mesh(undeclared, th()) == []
+
+
+# ---------------------------------------------------------- HLO walkers
+
+def test_hlo_collective_profile_counts_and_loop_bucket():
+    from raft_stereo_tpu.obs.xla import hlo_collective_profile
+    mesh = make_mesh(8, 1)
+
+    def body_psum(x):
+        def body(c, _):
+            return c + jax.lax.psum(c, "data"), None
+        c, _ = jax.lax.scan(body, x, None, length=3)
+        return c
+
+    f = shard_map(body_psum, mesh=mesh, in_specs=P("data"),
+                  out_specs=P("data"))
+    with mesh:
+        x = jax.device_put(np.ones((8, 16), np.float32),
+                           NamedSharding(mesh, P("data")))
+        compiled = jax.jit(f).lower(x).compile()
+    prof = hlo_collective_profile(compiled.as_text())
+    assert prof["by_kind"].get("all-reduce", 0) >= 1
+    assert prof["in_loop"].get("all-reduce", 0) >= 1
+
+
+# ------------------------------------------------------- fingerprint gate
+
+def fixture_doc():
+    """A hand-built two-target fingerprint doc (no lowering needed)."""
+    return {
+        "version": fp.FINGERPRINT_VERSION,
+        "meta": {"jax": jax.__version__, "platform": "cpu",
+                 "device_count": 8},
+        "targets": {
+            "train_step[dp]": {
+                "convs": {"outside_scans": 172,
+                          "scans": [{"length": 3, "convs_per_step": 15},
+                                    {"length": 3, "convs_per_step": 36}],
+                          "total": 223},
+                "collectives": {"by_kind": {"psum": 9}, "in_loop": {}},
+                "hlo_collectives": {"by_kind": {"all-reduce": 226},
+                                    "in_loop": {}},
+                "peak_bytes": 100_000_000,
+                "donation": {"declared": True, "aliased": True,
+                             "alias_bytes": 133424076},
+            },
+            "inference[ring]": {
+                "convs": {"outside_scans": 73,
+                          "scans": [{"length": 1, "convs_per_step": 13}],
+                          "total": 86},
+                "collectives": {"by_kind": {"ppermute": 9},
+                                "in_loop": {"ppermute": 3}},
+            },
+        },
+    }
+
+
+def errs(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+def test_identical_fingerprint_is_clean():
+    assert fp.diff_fingerprint(fixture_doc(), fixture_doc()) == []
+
+
+def test_wgrad_reentering_backward_loop_fires():
+    cur = fixture_doc()
+    # last scan = the backward loop; +6 per-step convs = the wgrad set back
+    cur["targets"]["train_step[dp]"]["convs"]["scans"][1][
+        "convs_per_step"] = 42
+    cur["targets"]["train_step[dp]"]["convs"]["outside_scans"] = 166
+    fs = errs(fp.diff_fingerprint(fixture_doc(), cur))
+    assert len(fs) == 2
+    assert any("re-entered the backward" in f.message for f in fs)
+
+
+def test_new_collective_and_loop_entry_fire():
+    cur = fixture_doc()
+    tgt = cur["targets"]["train_step[dp]"]["collectives"]
+    tgt["by_kind"]["all_gather"] = 2             # a kind the contract
+    fs = errs(fp.diff_fingerprint(fixture_doc(), cur))  # never named
+    assert len(fs) == 1 and "NEW collective" in fs[0].message
+
+    cur = fixture_doc()
+    tgt = cur["targets"]["train_step[dp]"]["collectives"]
+    tgt["in_loop"]["psum"] = 1                   # psum moved into the loop
+    fs = errs(fp.diff_fingerprint(fixture_doc(), cur))
+    assert any("MOVED INTO a loop body" in f.message for f in fs)
+
+
+def test_peak_bytes_gate_and_tolerance():
+    cur = fixture_doc()
+    cur["targets"]["train_step[dp]"]["peak_bytes"] = 108_000_000  # +8%
+    assert errs(fp.diff_fingerprint(fixture_doc(), cur)) == []
+    cur["targets"]["train_step[dp]"]["peak_bytes"] = 115_000_000  # +15%
+    fs = errs(fp.diff_fingerprint(fixture_doc(), cur))
+    assert len(fs) == 1 and "peak bytes jumped" in fs[0].message
+    fs = fp.diff_fingerprint(fixture_doc(), cur, peak_tolerance=0.20)
+    assert errs(fs) == []
+
+
+def test_donation_drop_and_missing_target():
+    cur = fixture_doc()
+    cur["targets"]["train_step[dp]"]["donation"]["aliased"] = False
+    fs = errs(fp.diff_fingerprint(fixture_doc(), cur))
+    assert len(fs) == 1 and "donation pairing changed" in fs[0].message
+
+    cur = fixture_doc()
+    del cur["targets"]["inference[ring]"]
+    fs = errs(fp.diff_fingerprint(fixture_doc(), cur))
+    assert len(fs) == 1 and "missing from the current build" in fs[0].message
+    # partial run (engine deselected / compile skipped): not drift
+    assert errs(fp.diff_fingerprint(fixture_doc(), cur, partial=True)) == []
+
+
+def test_fingerprint_round_trip_and_version_check(tmp_path):
+    path = str(tmp_path / "fp.json")
+    fp.write_fingerprint(path, fixture_doc())
+    assert fp.load_fingerprint(path) == fixture_doc()
+    bad = fixture_doc()
+    bad["version"] = 99
+    fp.write_fingerprint(path, bad)
+    with pytest.raises(ValueError):
+        fp.load_fingerprint(path)
+
+
+def test_target_fingerprint_jaxpr_only():
+    mesh = make_mesh(8, 1)
+
+    def sharded(x):
+        def body(c, _):
+            return c * 2, None
+        c, _ = jax.lax.scan(body, x, None, length=3)
+        return jax.lax.psum(c, "data")
+
+    f = shard_map(sharded, mesh=mesh, in_specs=P("data"), out_specs=P())
+    t = spmd_target(f, jnp.ones((8, 16)))
+    rec = fp.target_fingerprint(t)
+    assert rec["collectives"]["by_kind"] == {"psum2": 1}  # shard_map spelling
+    assert rec["collectives"]["in_loop"] == {}
+    assert "peak_bytes" not in rec              # uncompiled: jaxpr fields only
+
+
+# -------------------------------------- the CLI gate flips on injected drift
+
+def test_injected_regression_flips_cli_gate(tmp_path, capsys):
+    """Acceptance criterion: a structural regression (psum moved into the
+    scan body) against the CHECKED-IN fingerprint baseline makes
+    ``cli lint --fingerprint`` exit 1; the unmodified doc is green."""
+    from raft_stereo_tpu.analysis.runner import main as lint_main
+
+    baseline_path = os.path.join(REPO, fp.DEFAULT_FINGERPRINT)
+    if not os.path.exists(baseline_path):
+        pytest.skip("no checked-in fingerprint baseline")
+    clean = fp.load_fingerprint(baseline_path)
+    empty_baseline = str(tmp_path / ".graftlint.json")
+
+    current = str(tmp_path / "current.json")
+    fp.write_fingerprint(current, clean)
+    rc = lint_main(["--fingerprint-current", current,
+                    "--fingerprint-baseline", baseline_path,
+                    "--baseline", empty_baseline])
+    assert rc == 0, capsys.readouterr().out
+
+    doctored = json.loads(json.dumps(clean))
+    tgt = doctored["targets"]["train_step[dp]"]["collectives"]
+    tgt["in_loop"]["psum"] = 1
+    fp.write_fingerprint(current, doctored)
+    rc = lint_main(["--fingerprint-current", current,
+                    "--fingerprint-baseline", baseline_path,
+                    "--baseline", empty_baseline])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "MOVED INTO a loop body" in out
+
+
+def test_missing_baseline_is_an_error(tmp_path, capsys):
+    from raft_stereo_tpu.analysis.runner import main as lint_main
+
+    current = str(tmp_path / "current.json")
+    fp.write_fingerprint(current, fixture_doc())
+    rc = lint_main(["--fingerprint-current", current,
+                    "--fingerprint-baseline", str(tmp_path / "absent.json"),
+                    "--baseline", str(tmp_path / ".graftlint.json")])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# --------------------------------------------- rule_version staleness (#2)
+
+def test_rule_version_mismatch_flags_suppression_stale():
+    finding = Finding("cli-drift", "error", "cli.py::f", "drifted")
+    entries = [{"rule": "cli-drift", "location": "cli.py::f",
+                "reason": "known", "rule_version": 1}]
+    # same version: suppresses
+    applied, stale = apply_baseline([finding], entries,
+                                    rule_versions={"cli-drift": 1})
+    assert applied[0].suppressed and stale == []
+    # rule bumped to v2: entry goes stale and NO LONGER matches
+    finding = Finding("cli-drift", "error", "cli.py::f", "drifted")
+    applied, stale = apply_baseline([finding], entries,
+                                    rule_versions={"cli-drift": 2})
+    assert not applied[0].suppressed
+    assert len(stale) == 1 and "rule_version 1" in stale[0]["stale_reason"]
+    # renamed/retired rule: stale with its own reason
+    entries = [{"rule": "old-rule", "location": "x", "reason": "r"}]
+    applied, stale = apply_baseline([], entries,
+                                    rule_versions={"cli-drift": 2})
+    assert len(stale) == 1 and "renamed or retired" in stale[0]["stale_reason"]
+    # un-versioned legacy entry against a known rule still matches
+    finding = Finding("cli-drift", "error", "cli.py::f", "drifted")
+    entries = [{"rule": "cli-drift", "location": "cli.py::f", "reason": "r"}]
+    applied, stale = apply_baseline([finding], entries,
+                                    rule_versions={"cli-drift": 2})
+    assert applied[0].suppressed and stale == []
+
+
+def test_update_baseline_records_rule_versions(tmp_path):
+    from raft_stereo_tpu.analysis.findings import (baseline_from_findings,
+                                                   load_baseline,
+                                                   write_baseline)
+    doc = baseline_from_findings(
+        [Finding("cli-drift", "error", "cli.py::f", "m")],
+        rule_versions={"cli-drift": 2})
+    assert doc["suppressions"][0]["rule_version"] == 2
+    path = str(tmp_path / "b.json")
+    write_baseline(path, doc)
+    assert load_baseline(path)[0]["rule_version"] == 2
+
+
+# ------------------------------------------- entry-surface cli-drift (#1)
+
+def test_entry_surface_drift_fires_on_seeded_fixture(tmp_path):
+    from raft_stereo_tpu.analysis.ast_rules import check_entry_surface_drift
+
+    pkg = tmp_path / "raft_stereo_tpu"
+    pkg.mkdir()
+    (pkg / "cli.py").write_text(
+        "def build_eval_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('--dataset')\n"
+        "    p.add_argument('--orphan_flag')\n"
+        "    return p\n")
+    (tmp_path / "evaluate_stereo.py").write_text(
+        "from raft_stereo_tpu.cli import build_eval_parser\n"
+        "args = build_eval_parser().parse_args()\n"
+        "print(args.dataset)\n")
+    (tmp_path / "bench.py").write_text(
+        "from raft_stereo_tpu.config import RAFTStereoConfig\n"
+        "def run():\n"
+        "    return RAFTStereoConfig(bogus_field=3)\n")
+    fs = check_entry_surface_drift(str(tmp_path))
+    errors = {(f.data.get("dest") or f.data.get("keyword")) for f in fs}
+    assert errors == {"orphan_flag", "bogus_field"}
+    assert all(f.rule == "cli-drift" for f in fs)
+
+
+def test_entry_surfaces_clean_on_head():
+    from raft_stereo_tpu.analysis.ast_rules import check_entry_surface_drift
+
+    fs = check_entry_surface_drift(REPO)
+    assert [f for f in fs if f.severity == "error"] == []
+
+
+# ----------------------------------------------------------- clean tree
+
+@pytest.mark.slow  # 3 full-model traces (~17 s) — the non-slow tier's
+# budget is already spent on test_training's compile walls; the same
+# clean-tree guarantee runs every round in rehearse_round's
+# lint/fingerprint legs (full compile path, green runs in
+# runs/rehearsal.log)
+def test_head_passes_spmd_rules_jaxpr_only():
+    """The canonical sharded programs (shard_map DP step, the batched
+    custom-VJP twin, the dp x sp ring inference) carry zero SPMD-rule
+    violations at the jaxpr level. The compiled path (replication/mesh-
+    donation rules + the full fingerprint) runs in rehearse_round's
+    lint/fingerprint legs — green runs on record in runs/rehearsal.log."""
+    from raft_stereo_tpu.analysis.spmd_rules import (build_spmd_targets,
+                                                     run_spmd_rules)
+
+    targets = build_spmd_targets(compile_programs=False)
+    assert [t.name for t in targets] == [
+        "train_step[dp]", "train_step[dp,batched]", "inference[ring]"]
+    fs = run_spmd_rules(targets=targets)
+    assert [f for f in fs if f.severity == "error"] == [], \
+        [f.to_dict() for f in fs]
+    # the DP step's psum'd gradients and the ring's rotation are visible
+    from raft_stereo_tpu.obs.xla import collective_profile
+    dp = collective_profile(targets[0].closed_jaxpr)
+    assert dp["by_kind"].get("psum", 0) > 0 and not dp["in_loop"]
+    ring = collective_profile(targets[2].closed_jaxpr)
+    assert ring["in_loop"].get("ppermute", 0) > 0   # whitelisted by shape
