@@ -14,6 +14,12 @@ this module makes them declarative rules over two canonical lowerings:
   ``donate_argnums=(0,)``), plus a ``train_step[batched]`` variant with the
   custom-VJP scan + bf16 residuals engaged and its autodiff twin traced for
   comparison;
+* ``train_step[update]`` — the FULL :func:`make_train_step` program
+  (grad + optimizer + the r11 device-side anomaly guard's ``lax.cond``),
+  compiled with the state donated: the guard's skip path is jit-reachable
+  production code, so host-sync/dtype/donation contracts must hold over it
+  too — in particular that the cond does not break state donation (the
+  aliasing is re-verified on the compiled executable every lint run);
 * ``inference`` — the ``test_mode`` forward ``StereoPredictor`` jits.
 
 Same jaxpr topology as the real shapes (shape enters only aval sizes), so
@@ -455,13 +461,15 @@ def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
     """Lower the canonical step functions at a tiny shape (same topology as
     the production shapes — only aval sizes differ).
 
-    Three targets: the default autodiff ``train_step`` (compiled with
+    Four targets: the default autodiff ``train_step`` (compiled with
     ``donate_argnums=(0,)`` like bench.py / the DP path — the donation rule
     needs the executable), ``train_step[batched]`` (custom-VJP scan + bf16
     residual stacks, jaxpr-only, with its autodiff twin attached for the
-    wgrad placement diff), and the ``test_mode`` ``inference`` forward.
-    One model init is shared: the variant configs differ only in backward
-    scheduling, never in parameters."""
+    wgrad placement diff), ``train_step[update]`` (the full grad+optimizer
+    step with the anomaly-guard ``lax.cond``, compiled donated), and the
+    ``test_mode`` ``inference`` forward. One model init is shared: the
+    variant configs differ only in backward scheduling, never in
+    parameters."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -514,7 +522,30 @@ def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
         platform=platform,
         variants={"autodiff": jax.make_jaxpr(grad_fn(cfg_a))(params)}))
 
-    # 3) inference forward (what StereoPredictor jits)
+    # 3) full train step: grad + optimizer + the device-side anomaly guard
+    #    (training/state.py lax.cond), compiled with the state donated —
+    #    the guard must neither host-sync nor drop the donation aliasing
+    from raft_stereo_tpu.config import TrainConfig
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+    tx = fetch_optimizer(TrainConfig(batch_size=batch, train_iters=iters,
+                                     image_size=(h, w)))
+    state = TrainState.create(variables, tx)
+    full_step = make_train_step(model, tx, iters, fused_loss=True,
+                                anomaly_guard=True)
+    batch_data = {"image1": img1, "image2": img2, "flow": gt,
+                  "valid": jnp.ones((batch, h, w), jnp.float32)}
+    compiled_full = None
+    if compile_train:
+        compiled_full = jax.jit(full_step, donate_argnums=(0,)).lower(
+            state, batch_data).compile()
+    targets.append(GraphTarget(
+        name="train_step[update]", cfg=base,
+        closed_jaxpr=jax.make_jaxpr(full_step)(state, batch_data),
+        compiled=compiled_full, donate_declared=True, platform=platform))
+
+    # 4) inference forward (what StereoPredictor jits)
     def infer(v, a, b):
         return model.apply(v, a, b, iters=iters, test_mode=True)
 

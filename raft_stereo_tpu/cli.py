@@ -109,7 +109,11 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--name", default="raft-stereo",
                         help="name your experiment")
     parser.add_argument("--restore_ckpt", default=None,
-                        help="orbax state dir or reference .pth")
+                        help="orbax state dir, reference .pth, or 'auto' — "
+                             "resume from the newest manifest-valid "
+                             "checkpoint in ckpt_dir (corrupt/truncated/"
+                             "foreign ones are skipped with a "
+                             "ckpt_integrity event)")
     parser.add_argument("--batch_size", type=int, default=6)
     parser.add_argument("--train_datasets", nargs="+", default=["sceneflow"])
     parser.add_argument("--lr", type=float, default=0.0002)
@@ -144,6 +148,26 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                    help="stall-watchdog deadline: warn + emit a `stall` "
                         "event when no step completes within this many "
                         "seconds (0 disables)")
+    f = parser.add_argument_group(
+        "fault tolerance", "atomic checkpoints, preemption handling and "
+        "the device-side anomaly guard (training/resilience.py; drill: "
+        "scripts/fault_drill.py)")
+    f.add_argument("--checkpoint_frequency", type=int, default=None,
+                   help="checkpoint every N steps (default: ride "
+                        "validation_frequency); a SIGKILL loses at most "
+                        "this many steps, SIGTERM/SIGINT lose none")
+    f.add_argument("--ckpt_keep_last", type=int, default=3,
+                   help="retention: keep the newest K step checkpoints "
+                        "(0 = keep everything)")
+    f.add_argument("--ckpt_keep_every", type=int, default=0,
+                   help="retention: additionally spare checkpoints whose "
+                        "step is a multiple of N (0 = none)")
+    f.add_argument("--no_anomaly_guard", action="store_true",
+                   help="disable the lax.cond skip of optimizer updates "
+                        "on non-finite grad-norm/loss")
+    f.add_argument("--anomaly_max_skips", type=int, default=10,
+                   help="halt (for rollback to the last valid checkpoint) "
+                        "after M consecutive skipped updates (0 = never)")
 
 
 def train_config(args: argparse.Namespace) -> TrainConfig:
@@ -174,6 +198,11 @@ def train_config(args: argparse.Namespace) -> TrainConfig:
         grad_accum_steps=args.grad_accum_steps,
         run_dir=args.run_dir,
         stall_deadline_s=args.stall_deadline_s or None,
+        checkpoint_frequency=args.checkpoint_frequency,
+        ckpt_keep_last=args.ckpt_keep_last,
+        ckpt_keep_every=args.ckpt_keep_every,
+        anomaly_guard=not args.no_anomaly_guard,
+        anomaly_max_skips=args.anomaly_max_skips,
     )
 
 

@@ -230,6 +230,11 @@ class TrainConfig:
     """Training loop config (reference "Training parameters", train_stereo.py:220-231)."""
 
     name: str = "raft-stereo"
+    # Path to an orbax state dir / reference .pth, or the literal "auto":
+    # scan ckpt_dir for this run's checkpoints, verify each manifest
+    # (training/resilience.py), and resume from the newest VALID one —
+    # truncated/corrupt/foreign checkpoints are skipped with a
+    # `ckpt_integrity` event. No valid checkpoint = fresh start.
     restore_ckpt: Optional[str] = None
     batch_size: int = 6
     train_datasets: Tuple[str, ...] = ("sceneflow",)
@@ -267,6 +272,26 @@ class TrainConfig:
     # step to let initial compilation through). None/0 disables the watchdog.
     run_dir: str = "runs"
     stall_deadline_s: Optional[float] = 300.0
+    # Fault tolerance (training/resilience.py). Checkpoint cadence in
+    # steps; None rides validation_frequency (the pre-r11 behavior —
+    # checkpoints only ever landed beside validations). A preemptible-pod
+    # recipe sets this much tighter than the validation cadence: a SIGKILL
+    # loses at most this many steps of work (SIGTERM/SIGINT lose none —
+    # the preemption handler saves before exiting).
+    checkpoint_frequency: Optional[int] = None
+    # Retention over step checkpoints: keep the newest K (0 disables the
+    # sweep entirely — nothing is ever deleted), sparing any checkpoint
+    # whose step is a multiple of ckpt_keep_every (0 = no sparing).
+    ckpt_keep_last: int = 3
+    ckpt_keep_every: int = 0
+    # Device-side anomaly guard (training/state.py): lax.cond skips the
+    # optimizer update when the global grad norm or loss is non-finite —
+    # no host sync, step counter still advances. anomaly_max_skips is the
+    # host-side halt policy: after M CONSECUTIVE skipped updates the run
+    # raises AnomalyHalt for rollback to the last durable checkpoint
+    # (0 = never halt; isolated skips only ever cost their own batch).
+    anomaly_guard: bool = True
+    anomaly_max_skips: int = 10
 
 
 # --- Named presets mirroring the reference's published training commands -------------

@@ -10,10 +10,22 @@ Determinism: sample ``i`` of epoch ``e`` is always decoded with
 ``Philox(key=(seed, e, perm[i]))`` — the stream does not depend on worker
 count or scheduling, unlike worker-id-seeded torch loaders
 (stereo_datasets.py:55-61).
+
+I/O resilience (training/resilience.py is the checkpoint half; this is the
+data half): a decode failure is retried ``decode_retries`` times with
+exponential backoff (transient NFS/GCS hiccups), then the sample is
+QUARANTINED — deterministically substituted by the next decodable dataset
+index, decoded with the **original slot's** Philox key. Substitution
+consumes no other slot's randomness and depends only on (epoch, index,
+which samples are broken), so a resumed run quarantines identically and
+the Philox exact-resume contract survives bad files. Quarantines are
+logged and reported through ``quarantine_hook`` (the trainer forwards them
+as ``anomaly`` events with ``kind="loader_quarantine"``).
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -21,6 +33,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 BATCH_FIELDS = ("image1", "image2", "flow", "valid")
 
@@ -59,7 +73,8 @@ class Loader:
 
     def __init__(self, dataset, batch_size: int, seed: int = 0,
                  num_workers: int = 4, shuffle: bool = True,
-                 drop_last: bool = True, prefetch: int = 4):
+                 drop_last: bool = True, prefetch: int = 4,
+                 decode_retries: int = 2, retry_backoff_s: float = 0.05):
         self.dataset = dataset
         self.batch_size = batch_size
         self.seed = seed
@@ -68,6 +83,12 @@ class Loader:
         self.drop_last = drop_last
         self.prefetch = prefetch
         self.epoch = 0
+        # I/O resilience (module doc): bounded retry-with-backoff on decode
+        # failures, then deterministic skip-and-quarantine.
+        self.decode_retries = max(0, decode_retries)
+        self.retry_backoff_s = retry_backoff_s
+        self.quarantine_hook: Optional[Callable[[Dict], None]] = None
+        self.quarantined: list = []  # records of substituted samples
         # Optional telemetry hook (set by the trainer): called from the
         # producer thread with queue-depth/wait gauges every GAUGE_EVERY
         # batches. Must never raise into the pipeline — calls are guarded.
@@ -89,10 +110,58 @@ class Loader:
             n += 1
         return n
 
-    def _sample(self, epoch: int, index: int) -> Dict[str, np.ndarray]:
-        rng = np.random.Generator(
+    def _rng(self, epoch: int, index: int) -> np.random.Generator:
+        return np.random.Generator(
             np.random.Philox(key=[(self.seed << 32) + epoch, index]))
-        return self.dataset.sample(index, rng)
+
+    def _sample(self, epoch: int, index: int) -> Dict[str, np.ndarray]:
+        return self.dataset.sample(index, self._rng(epoch, index))
+
+    # Bounded substitution scan: how many forward dataset indices to try
+    # before declaring the dataset unusable and propagating the original
+    # decode error (a whole broken dataset must fail fast, not spin).
+    _QUARANTINE_SCAN = 64
+
+    def _sample_resilient(self, epoch: int, index: int
+                          ) -> Dict[str, np.ndarray]:
+        """Decode with retry + backoff; quarantine and substitute on a
+        persistent failure (see module doc). Runs on pool threads."""
+        delay = self.retry_backoff_s
+        error: Optional[Exception] = None
+        for attempt in range(self.decode_retries + 1):
+            try:
+                return self._sample(epoch, index)
+            except Exception as e:
+                error = e
+                if attempt < self.decode_retries:
+                    time.sleep(delay)
+                    delay *= 2
+        # persistent failure: substitute the next decodable index, decoded
+        # with the ORIGINAL slot's rng — every other sample in the stream
+        # stays bitwise identical, so resume reproduces the same stream
+        n = len(self.dataset)
+        for k in range(1, min(n, self._QUARANTINE_SCAN)):
+            sub = (index + k) % n
+            try:
+                sample = self.dataset.sample(sub, self._rng(epoch, index))
+            except Exception:
+                continue
+            record = {"epoch": epoch, "index": int(index),
+                      "substitute": int(sub),
+                      "error": f"{type(error).__name__}: {error}",
+                      "retries": self.decode_retries}
+            self.quarantined.append(record)
+            logger.warning(
+                "quarantined sample %d (epoch %d) after %d retries: %s — "
+                "substituted index %d", index, epoch, self.decode_retries,
+                record["error"], sub)
+            if self.quarantine_hook is not None:
+                try:
+                    self.quarantine_hook(dict(record))
+                except Exception:
+                    self.quarantine_hook = None  # never break the pipeline
+            return sample
+        raise error
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         epoch = self.epoch
@@ -118,7 +187,7 @@ class Loader:
             decode_wait = put_wait = 0.0
             with ThreadPoolExecutor(self.num_workers) as pool:
                 # pipeline sample futures one batch ahead of consumption
-                futures = [pool.submit(self._sample, epoch, int(i))
+                futures = [pool.submit(self._sample_resilient, epoch, int(i))
                            for i in order[:min(len(order),
                                                2 * self.batch_size)]]
                 submitted = len(futures)
@@ -128,7 +197,8 @@ class Loader:
                     while submitted < len(order) and \
                             len(futures) < 2 * self.batch_size:
                         futures.append(pool.submit(
-                            self._sample, epoch, int(order[submitted])))
+                            self._sample_resilient, epoch,
+                            int(order[submitted])))
                         submitted += 1
                     try:
                         t0 = time.perf_counter()

@@ -27,16 +27,17 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 # Back-compat: every schema version whose artifacts are still readable.
 # v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
-# op_counts jaxpr profile event) and v3 -> v4 (the graftlint `lint` report
-# event) were purely ADDITIVE — no earlier event changed its required
-# fields — so pre-existing runs/*/events.jsonl lint clean: an older record
-# is validated against its own surface (it just may not use events
-# introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4)
+# op_counts jaxpr profile event), v3 -> v4 (the graftlint `lint` report
+# event) and v4 -> v5 (the fault-tolerance events: preempt/resume/
+# ckpt_integrity/anomaly) were purely ADDITIVE — no earlier event changed
+# its required fields — so pre-existing runs/*/events.jsonl lint clean: an
+# older record is validated against its own surface (it just may not use
+# events introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -45,6 +46,10 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "xla_cost": 2,
     "op_counts": 3,
     "lint": 4,
+    "preempt": 5,
+    "resume": 5,
+    "ckpt_integrity": 5,
+    "anomaly": 5,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -87,6 +92,24 @@ EVENT_TYPES: Dict[str, tuple] = {
     "lint": ("source", "findings"),
     "stall": ("seconds_since_step", "deadline_s"),
     "error": ("error",),
+    # Fault tolerance (training/resilience.py, schema v5). `preempt`: a
+    # SIGTERM/SIGINT triggered the save-and-exit path (`signal` is the
+    # name, `step` where training stopped; the matching `checkpoint` event
+    # carries reason="preempt"). `resume`: a restore positioned the run at
+    # `step` from checkpoint `path` (auto-resume or explicit
+    # --restore_ckpt). `ckpt_integrity`: one verification verdict per
+    # candidate scanned by `--restore_ckpt auto` (`ok` bool; `reason` rides
+    # along on failure — truncated file, crc mismatch, config-digest
+    # mismatch). `anomaly`: non-finite-gradient skips
+    # (kind="nonfinite_grad", with step/grad_norm/consecutive),
+    # the halt decision after M consecutive skips (kind="halt"), loader
+    # quarantines (kind="loader_quarantine", with epoch/index/substitute)
+    # and a non-finite state blocking an emergency save
+    # (kind="nonfinite_state").
+    "preempt": ("signal", "step"),
+    "resume": ("step", "path"),
+    "ckpt_integrity": ("path", "ok"),
+    "anomaly": ("kind",),
     "run_end": ("steps",),
 }
 

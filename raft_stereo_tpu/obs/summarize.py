@@ -176,8 +176,20 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "total_s": round(sum(e.get("duration_s", 0.0)
                                  for e in by("compile")), 3),
         },
-        "checkpoints": [{"step": e.get("step"), "path": e.get("path")}
+        "checkpoints": [{"step": e.get("step"), "path": e.get("path"),
+                         **({"reason": e["reason"]} if "reason" in e
+                            else {})}
                         for e in by("checkpoint")],
+        # fault tolerance (schema v5, training/resilience.py): preemption,
+        # resume provenance, checkpoint-integrity verdicts, anomaly skips
+        "preempts": [{"signal": e.get("signal"), "step": e.get("step")}
+                     for e in by("preempt")],
+        "resumes": [{"step": e.get("step"), "path": e.get("path")}
+                    for e in by("resume")],
+        "ckpt_integrity_failures": [
+            {"path": e.get("path"), "reason": e.get("reason")}
+            for e in by("ckpt_integrity") if not e.get("ok")],
+        "anomalies": _anomaly_summary(by("anomaly")),
         "validations": [e.get("results") for e in by("validation")],
         "stalls": [{"t": e.get("t"),
                     "seconds_since_step": e.get("seconds_since_step"),
@@ -194,6 +206,21 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["memory_last"] = {k: last[k] for k in
                               ("bytes_in_use", "peak_bytes_in_use")
                               if k in last}
+    return out
+
+
+def _anomaly_summary(anomalies: List[Dict[str, Any]]
+                     ) -> Optional[Dict[str, Any]]:
+    if not anomalies:
+        return None
+    by_kind: Dict[str, int] = {}
+    for e in anomalies:
+        kind = e.get("kind", "?")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    out: Dict[str, Any] = {"count": len(anomalies), "by_kind": by_kind}
+    skips = [e for e in anomalies if e.get("kind") == "nonfinite_grad"]
+    if skips:
+        out["skipped_update_steps"] = [e.get("step") for e in skips]
     return out
 
 
@@ -263,8 +290,25 @@ def format_summary(report: Dict[str, Any]) -> str:
         lines.append("")
         lines.append(f"compiles: {c['count']} ({c['total_s']} s)")
         lines.append(f"checkpoints: {len(ev['checkpoints'])}"
-                     + ("".join(f"\n  step {k['step']}: {k['path']}"
-                                for k in ev["checkpoints"][-3:])))
+                     + ("".join(
+                         f"\n  step {k['step']}"
+                         + (f" [{k['reason']}]" if "reason" in k else "")
+                         + f": {k['path']}"
+                         for k in ev["checkpoints"][-3:])))
+        for p in ev.get("preempts", []):
+            lines.append(f"PREEMPT: {p['signal']} at step {p['step']} "
+                         f"(saved; resume with --restore_ckpt auto)")
+        for r in ev.get("resumes", []):
+            lines.append(f"resumed: step {r['step']} from {r['path']}")
+        for f_ in ev.get("ckpt_integrity_failures", []):
+            lines.append(f"CKPT INTEGRITY: skipped {f_['path']} "
+                         f"({f_['reason']})")
+        an = ev.get("anomalies")
+        if an:
+            lines.append(f"ANOMALIES: {an['count']} ({an['by_kind']})"
+                         + (f", skipped updates at steps "
+                            f"{an['skipped_update_steps']}"
+                            if "skipped_update_steps" in an else ""))
         for v in ev["validations"]:
             lines.append(f"validation: {v}")
         if "memory_last" in ev:

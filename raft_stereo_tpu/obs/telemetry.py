@@ -179,8 +179,11 @@ class Telemetry:
         self._last_beat = time.monotonic()
         self._stalled = False
 
-    def checkpoint(self, step: int, path: str) -> None:
-        self.emit("checkpoint", step=int(step), path=path)
+    def checkpoint(self, step: int, path: str, **payload: Any) -> None:
+        """``reason`` rides along as an extra field: "periodic" saves omit
+        it; the fault-tolerance paths stamp "preempt"/"crash"/"final"
+        (training/resilience.py)."""
+        self.emit("checkpoint", step=int(step), path=path, **payload)
         self.memory()
 
     def validation(self, results: Dict[str, float],

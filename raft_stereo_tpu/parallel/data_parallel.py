@@ -51,17 +51,24 @@ from raft_stereo_tpu.training.state import TrainState, make_train_step
 
 
 def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh,
-                             fused_loss: bool = False):
+                             fused_loss: bool = False,
+                             anomaly_guard: bool = True):
     """Explicit-collective DP train step (state replicated, batch sharded on B).
 
     ``fused_loss`` selects the in-scan/tile-layout loss (the fastest measured
     step variant): per-shard error sums are already ``psum``-normalized
     globally inside :func:`sequence_loss_fused` via ``axis_name``, so the
     sharded step is identical math to the single-chip fused step.
+
+    ``anomaly_guard`` (default on): the non-finite-gradient ``lax.cond``
+    skip in :func:`make_train_step`. Its predicate reads the psum'd
+    gradients/loss, so every shard takes the same branch — no divergence,
+    no extra collective.
     """
     per_shard_step = make_train_step(model, tx, train_iters,
                                      axis_name=DATA_AXIS,
-                                     fused_loss=fused_loss)
+                                     fused_loss=fused_loss,
+                                     anomaly_guard=anomaly_guard)
 
     batch_spec = {"image1": P(DATA_AXIS), "image2": P(DATA_AXIS),
                   "flow": P(DATA_AXIS), "valid": P(DATA_AXIS)}
@@ -76,12 +83,15 @@ def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh,
 
 
 def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
-                         fused_loss: bool = False):
+                         fused_loss: bool = False,
+                         anomaly_guard: bool = True):
     """Auto-SPMD dp+sp train step: jit with sharding-annotated inputs.
 
     ``fused_loss`` is written globally (no explicit collectives): the SPMD
     partitioner turns the in-scan/tile-layout error reductions into the same
-    cross-device sums the stacked loss gets.
+    cross-device sums the stacked loss gets. ``anomaly_guard``: see
+    :func:`make_shardmap_train_step` — under auto-SPMD the cond predicate
+    is a replicated scalar, so the guard adds no collectives either.
     """
     import dataclasses
 
@@ -96,7 +106,8 @@ def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
         model = model.clone(
             cfg=dataclasses.replace(model.cfg, fused_lookup=False))
     step = make_train_step(model, tx, train_iters, axis_name=None,
-                           fused_loss=fused_loss)
+                           fused_loss=fused_loss,
+                           anomaly_guard=anomaly_guard)
     state_sharding = replicated(mesh)
     return jax.jit(
         step,

@@ -1,3 +1,7 @@
 from raft_stereo_tpu.training.loss import sequence_loss
 from raft_stereo_tpu.training.optim import fetch_optimizer, one_cycle_lr
+from raft_stereo_tpu.training.resilience import (AnomalyHalt, AnomalyPolicy,
+                                                 SignalGuard, config_digest,
+                                                 find_latest_valid,
+                                                 verify_checkpoint)
 from raft_stereo_tpu.training.state import TrainState, make_train_step

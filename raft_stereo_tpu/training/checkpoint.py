@@ -8,6 +8,15 @@ EXACTLY — epoch and intra-epoch batch index both; the loader's Philox-keyed
 per-(epoch, index) decode makes the resumed stream identical to an
 uninterrupted run's, see data/loader.py).
 
+Saves go through the atomic protocol in
+:mod:`raft_stereo_tpu.training.resilience`: temp dir -> fsync -> rename,
+with a per-checkpoint ``MANIFEST.json`` (step, config digest,
+pytree-structure hash, per-file size+crc32) that ``--restore_ckpt auto``
+verifies before trusting a checkpoint. The old ``force=True`` overwrite —
+which let a new run named like an old one destroy its final checkpoint, and
+a kill mid-save leave a half-written dir — is gone; a mismatched config
+digest rotates the existing target to ``<name>.bak`` instead.
+
 Weights-only interop with reference ``.pth`` files lives in
 :mod:`raft_stereo_tpu.utils.checkpoint_convert`.
 """
@@ -17,34 +26,37 @@ from __future__ import annotations
 import os
 from typing import Any, Optional
 
-import jax
-import numpy as np
-
-
-def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.PyTreeCheckpointer()
+from raft_stereo_tpu.training.resilience import (atomic_save_train_state,
+                                                 checkpoint_state_dir)
 
 
 def save_train_state(ckpt_dir: str, name: str, state: Any,
-                     step: Optional[int] = None) -> str:
-    """Save the full TrainState; returns the checkpoint path.
+                     step: Optional[int] = None,
+                     config_digest: Optional[str] = None,
+                     keep_last: int = 0, keep_every: int = 0,
+                     reason: str = "periodic") -> str:
+    """Save the full TrainState atomically; returns the checkpoint path.
 
     Layout mirrors the reference naming: ``<ckpt_dir>/<step>_<name>`` for
     periodic saves, ``<ckpt_dir>/<name>`` for the final one
-    (train_stereo.py:184-186, 208-209).
+    (train_stereo.py:184-186, 208-209); each checkpoint dir holds the orbax
+    tree under ``state/`` plus its integrity manifest. ``config_digest``
+    stamps the manifest (and arms the same-name clobber protection);
+    ``keep_last``/``keep_every`` run retention over step checkpoints.
     """
-    tag = name if step is None else f"{step}_{name}"
-    path = os.path.abspath(os.path.join(ckpt_dir, tag))
-    state = jax.device_get(state)
-    _checkpointer().save(path, state, force=True)
-    return path
+    return atomic_save_train_state(
+        ckpt_dir, name, state, step=step, config_digest=config_digest,
+        keep_last=keep_last, keep_every=keep_every, reason=reason)
 
 
 def restore_train_state(path: str, target: Any) -> Any:
     """Restore a TrainState saved by :func:`save_train_state`.
 
-    ``target`` supplies the pytree structure/dtypes (a freshly created state).
+    ``target`` supplies the pytree structure/dtypes (a freshly created
+    state). Accepts both the manifest layout (``<path>/state``) and legacy
+    bare orbax dirs.
     """
-    restored = _checkpointer().restore(os.path.abspath(path), item=target)
-    return restored
+    import orbax.checkpoint as ocp
+
+    state_dir = checkpoint_state_dir(os.path.abspath(path))
+    return ocp.PyTreeCheckpointer().restore(state_dir, item=target)

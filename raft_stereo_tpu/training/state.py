@@ -37,7 +37,8 @@ class TrainState(struct.PyTreeNode):
 
 
 def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
-                    axis_name=None, fused_loss: bool = False):
+                    axis_name=None, fused_loss: bool = False,
+                    anomaly_guard: bool = True):
     """Build the jittable training step.
 
     ``batch``: dict with ``image1``/``image2`` ``(B,H,W,3)`` float images,
@@ -49,7 +50,25 @@ def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
     iteration's masked L1 inside its refinement scan instead of stacking the
     full-resolution predictions) — same math, different HBM profile; the
     stacked default measured faster under full remat.
+
+    ``anomaly_guard`` (the device-side half of the fault-tolerance story,
+    training/resilience.py): a ``lax.cond`` on the finiteness of the global
+    gradient norm AND the loss skips the optimizer update entirely — params
+    and optimizer state pass through untouched — so one NaN/Inf batch
+    cannot poison the remaining 100k steps of the schedule. The predicate
+    is computed on device and never concretized on the host (graftlint's
+    ``host-sync``/``tracer-unsafe`` rules stay green over this path; the
+    naive ``float(grad_norm)``-per-step alternative is the seeded-violation
+    fixture in tests/test_resilience.py). The step counter still advances
+    on a skipped update — it counts consumed batches, which is what the
+    loader's exact-resume repositioning needs. Metrics gain ``grad_norm``
+    and ``skipped_updates`` (0/1 this step); the host-side
+    :class:`~raft_stereo_tpu.training.resilience.AnomalyPolicy` reads them
+    off the lagged metrics fetch and halts after M consecutive skips.
+    Under ``shard_map`` the predicate is computed from the psum'd gradients
+    and loss, so every device takes the same branch.
     """
+    import jax.numpy as jnp
 
     def train_step(state: TrainState, batch):
         def loss_fn(params):
@@ -73,11 +92,32 @@ def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
             loss_fn, has_aux=True)(state.params)
         if axis_name is not None:
             grads = jax.lax.psum(grads, axis_name)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        if anomaly_guard:
+            grad_norm = optax.global_norm(grads)
+            finite = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
+
+            def _apply(operand):
+                g, opt_state_, params_ = operand
+                updates, new_opt = tx.update(g, opt_state_, params_)
+                return optax.apply_updates(params_, updates), new_opt
+
+            def _skip(operand):
+                _g, opt_state_, params_ = operand
+                return params_, opt_state_
+
+            params, opt_state = jax.lax.cond(
+                finite, _apply, _skip,
+                (grads, state.opt_state, state.params))
+            metrics = dict(metrics, loss=loss, grad_norm=grad_norm,
+                           skipped_updates=1.0
+                           - finite.astype(jnp.float32))
+        else:
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            metrics = dict(metrics, loss=loss)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
-        metrics = dict(metrics, loss=loss)
         return new_state, metrics
 
     return train_step

@@ -7,9 +7,32 @@ checkpoints, and the validate-on-Things hook every ``validation_frequency``
 steps (train_stereo.py:183-190). Differences from the reference, by design:
 
 * full-state checkpoints (exact resume, incl. schedule position) via orbax;
-  ``--restore_ckpt`` also accepts reference ``.pth`` files (weights-only),
+  ``--restore_ckpt`` also accepts reference ``.pth`` files (weights-only)
+  and the literal ``auto`` (resume from the newest manifest-valid
+  checkpoint — training/resilience.py),
 * no GradScaler: bf16 needs no loss scaling; grad-clip 1.0 is kept,
 * BatchNorm is frozen structurally (nn/layers.py) — no ``freeze_bn`` dance.
+
+Fault tolerance (the r11 layer; proven by scripts/fault_drill.py):
+
+* checkpoints are atomic (temp dir + fsync + rename, integrity manifest)
+  and decoupled from validation via ``cfg.checkpoint_frequency``;
+* SIGTERM/SIGINT trigger a save-and-exit path (``preempt`` event + a
+  checkpoint with ``reason="preempt"``) instead of losing the work since
+  the last periodic save; a crash (the ``except BaseException`` path)
+  writes a best-effort emergency checkpoint, skipped with a logged warning
+  when the state is non-finite;
+* the train step's device-side anomaly guard (training/state.py) skips the
+  optimizer update on non-finite grad-norm/loss without host sync; the
+  host-side :class:`~raft_stereo_tpu.training.resilience.AnomalyPolicy`
+  reads ``skipped_updates`` off the lagged metrics fetch and halts (for
+  rollback to the last durable checkpoint) after M consecutive skips.
+
+Step telemetry is emitted on the SAME one-step lag as the metrics fetch:
+the ``step`` event for step *i* lands while step *i+1* runs on device and
+carries ``loss``/``grad_norm``/``skipped_updates`` — so a run's event
+stream is a replayable record of its loss trajectory (what the fault
+drill's oracle comparison diffs), without adding a host sync per step.
 """
 
 from __future__ import annotations
@@ -31,6 +54,7 @@ from raft_stereo_tpu.models import init_model
 from raft_stereo_tpu.obs import Telemetry
 from raft_stereo_tpu.parallel.data_parallel import make_pjit_train_step
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicated, shard_batch
+from raft_stereo_tpu.training import resilience
 from raft_stereo_tpu.training.checkpoint import (restore_train_state,
                                                  save_train_state)
 from raft_stereo_tpu.training.logger import Logger
@@ -89,10 +113,42 @@ def _compile_step_introspected(step_fn, state, placed, tel):
     return compiled
 
 
+def _emergency_checkpoint(exc: BaseException, state, cfg: TrainConfig,
+                          tel, global_step: int,
+                          run_digest: Optional[str]) -> Optional[str]:
+    """Best-effort crash-path checkpoint (the ``except BaseException``
+    satellite): save the in-flight state with ``reason="crash"`` so a
+    crash costs zero steps — UNLESS the state is non-finite (warn + emit
+    ``anomaly kind=nonfinite_state``; the rollback target is then the
+    last periodic checkpoint) or the exception is an
+    :class:`~raft_stereo_tpu.training.resilience.AnomalyHalt` (which
+    rolls back *by design* — saving would defeat it). Never raises."""
+    if isinstance(exc, resilience.AnomalyHalt):
+        return None
+    try:
+        if resilience.state_is_finite(state):
+            path = save_train_state(
+                cfg.ckpt_dir, cfg.name, state, step=global_step,
+                config_digest=run_digest, reason="crash")
+            logger.warning("emergency checkpoint after %s: %s",
+                           type(exc).__name__, path)
+            tel.checkpoint(global_step, path, reason="crash")
+            return path
+        logger.warning(
+            "NOT saving emergency checkpoint: state is non-finite "
+            "(resume from the last periodic checkpoint instead)")
+        tel.emit("anomaly", kind="nonfinite_state", step=global_step)
+    except Exception:
+        logger.warning("emergency checkpoint failed", exc_info=True)
+    return None
+
+
 def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
           validate_every: Optional[int] = None) -> str:
-    """Run training to ``cfg.num_steps``; returns the final checkpoint path."""
+    """Run training to ``cfg.num_steps``; returns the final checkpoint path
+    (on preemption: the preempt checkpoint's path)."""
     validation_frequency = validate_every or cfg.validation_frequency
+    ckpt_frequency = cfg.checkpoint_frequency or validation_frequency
     os.makedirs(cfg.ckpt_dir, exist_ok=True)
 
     mesh = make_mesh(cfg.data_parallel, cfg.seq_parallel)
@@ -111,8 +167,23 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
 
     tx = fetch_optimizer(cfg)
     state = TrainState.create(variables, tx)
-    if cfg.restore_ckpt:
+    # the run-identity stamp: clobber protection + auto-resume filtering
+    run_digest = resilience.config_digest(model_cfg, cfg)
+    integrity_reports = []
+    resume_from = None
+    if cfg.restore_ckpt == "auto":
+        best, integrity_reports = resilience.find_latest_valid(
+            cfg.ckpt_dir, cfg.name, config_digest=run_digest,
+            tree_hash=resilience.tree_structure_hash(jax.device_get(state)))
+        if best is not None:
+            state = _restore(best, state, model_cfg, variables)
+            resume_from = best
+        else:
+            logger.info("--restore_ckpt auto: no valid checkpoint for %r "
+                        "under %s; starting fresh", cfg.name, cfg.ckpt_dir)
+    elif cfg.restore_ckpt:
         state = _restore(cfg.restore_ckpt, state, model_cfg, variables)
+        resume_from = cfg.restore_ckpt
 
     loader = fetch_dataloader(cfg)
     accum_k = max(cfg.grad_accum_steps, 1)
@@ -131,12 +202,23 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                     stall_deadline_s=cfg.stall_deadline_s)
     tel.run_start(config={"model": dataclasses.asdict(model_cfg),
                           "train": dataclasses.asdict(cfg)},
-                  n_params=int(n_params), resumed_step=int(state.step))
+                  n_params=int(n_params), resumed_step=int(state.step),
+                  config_digest=run_digest)
+    for report in integrity_reports:
+        tel.emit("ckpt_integrity", **report)
+    if resume_from is not None:
+        tel.emit("resume", step=int(state.step), path=resume_from)
     loader.gauge_hook = tel.loader_gauge
+    loader.quarantine_hook = lambda info: tel.emit(
+        "anomaly", kind="loader_quarantine", **info)
+    policy = resilience.AnomalyPolicy(
+        cfg.anomaly_max_skips if cfg.anomaly_guard else 0, telemetry=tel)
+    nan_step = resilience.injected_nan_step()
 
     with mesh:
         state = jax.device_put(state, replicated(mesh))
-        step_fn = make_pjit_train_step(model, tx, cfg.train_iters, mesh)
+        step_fn = make_pjit_train_step(model, tx, cfg.train_iters, mesh,
+                                       anomaly_guard=cfg.anomaly_guard)
 
         # console/TB logging rides the run dir telemetry owns; write_dict
         # mirrors validation results onto the event bus
@@ -144,80 +226,133 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                      telemetry=tel)
         validation_predictor = None  # built lazily, reused across validations
         global_step = start_step = int(state.step)
-        pending = None  # lagged metrics fetch: sync step i-1 while i runs
+        # lagged metrics fetch: (step, metrics, timing) for step i is
+        # synced — and its `step` event emitted — while step i+1 runs
+        pending = None
         batches = infinite_batches(loader)
         step_impl = None  # AOT-compiled on the first batch (shapes known)
-        try:
-            while global_step < cfg.num_steps:
-                t0 = time.perf_counter()
-                batch = next(batches)
-                t1 = time.perf_counter()
-                placed = shard_batch(mesh, batch)
-                if step_impl is None:
-                    step_impl = _compile_step_introspected(
-                        step_fn, state, placed, tel)
-                state, metrics = step_impl(state, placed)
-                t2 = time.perf_counter()
-                if pending is not None:
-                    log.push({k: float(v) for k, v in pending.items()},
-                             lr=float(schedule((global_step - 1) // accum_k)))
-                t3 = time.perf_counter()
-                pending = metrics
-                global_step += 1
-                if global_step == start_step + 1:
-                    # first-call latency: the pjit dispatch above compiled
-                    # synchronously (remote-helper time included — invisible
-                    # to the jax.monitoring compile hook)
-                    tel.emit("compile", duration_s=round(t2 - t1, 3),
-                             source="first_step_latency")
-                tel.step(global_step, data_wait_s=t1 - t0,
-                         dispatch_s=t2 - t1, fetch_s=t3 - t2,
-                         batch_size=cfg.batch_size)
+        preempted = False
 
-                if global_step % validation_frequency == 0:
-                    # flush the in-flight metrics first so validation scalars
-                    # and the checkpoint agree on the step axis
-                    if pending is not None:
-                        log.push(
-                            {k: float(v) for k, v in pending.items()},
-                            lr=float(schedule((global_step - 1) // accum_k)))
-                        pending = None
-                    ckpt = save_train_state(cfg.ckpt_dir, cfg.name, state,
-                                            step=global_step)
-                    logger.info("saved %s", ckpt)
-                    tel.checkpoint(global_step, ckpt)
-                    variables_host = jax.device_get(state.variables)
-                    if validation_predictor is None:
-                        from raft_stereo_tpu.inference import StereoPredictor
-                        validation_predictor = StereoPredictor(
-                            model_cfg, variables_host,
-                            valid_iters=cfg.valid_iters)
-                    else:  # keep the jit cache, refresh only the weights
-                        validation_predictor.variables = variables_host
-                    results = _maybe_validate_things(validation_predictor, cfg)
-                    if results:
-                        log.write_dict(results)
-                    pps = tel.window_throughput()
-                    if pps is not None:
-                        logger.info(
-                            "throughput: %.2f pairs/sec over last window", pps)
+        def flush_pending():
+            nonlocal pending
+            if pending is None:
+                return
+            step_i, metrics, timing = pending
+            pending = None
+            vals = {k: float(v) for k, v in metrics.items()}
+            log.push(vals, lr=float(schedule((step_i - 1) // accum_k)))
+            extras = {k: vals[k]
+                      for k in ("loss", "grad_norm", "skipped_updates")
+                      if k in vals}
+            tel.step(step_i, batch_size=cfg.batch_size, **timing, **extras)
+            policy.observe(bool(vals.get("skipped_updates", 0.0)), step_i,
+                           grad_norm=vals.get("grad_norm"))
 
-            if pending is not None:
-                log.push({k: float(v) for k, v in pending.items()},
-                         lr=float(schedule((global_step - 1) // accum_k)))
-            final = save_train_state(cfg.ckpt_dir, cfg.name, state)
-            tel.checkpoint(global_step, final)
-        except BaseException as e:
-            tel.error(e)
-            tel.emit("run_end", steps=global_step - start_step, ok=False,
-                     step=global_step)
-            tel.close()
-            raise
-        finally:
-            log.close()
+        with resilience.SignalGuard() as guard:
+            try:
+                while global_step < cfg.num_steps:
+                    if guard.requested:
+                        preempted = True
+                        break
+                    t0 = time.perf_counter()
+                    batch = next(batches)
+                    t1 = time.perf_counter()
+                    if nan_step is not None and global_step + 1 == nan_step:
+                        # scripts/fault_drill.py's injection hook: prove the
+                        # device guard survives a poisoned batch
+                        logger.warning("fault injection: NaN batch at "
+                                       "step %d", nan_step)
+                        batch = dict(batch, image1=np.full_like(
+                            batch["image1"], np.nan))
+                    placed = shard_batch(mesh, batch)
+                    if step_impl is None:
+                        step_impl = _compile_step_introspected(
+                            step_fn, state, placed, tel)
+                    state, metrics = step_impl(state, placed)
+                    t2 = time.perf_counter()
+                    flush_pending()  # sync step i-1 while step i runs
+                    t3 = time.perf_counter()
+                    pending = (global_step + 1, metrics,
+                               {"data_wait_s": t1 - t0,
+                                "dispatch_s": t2 - t1,
+                                "fetch_s": t3 - t2})
+                    global_step += 1
+                    if global_step == start_step + 1:
+                        # first-call latency: the pjit dispatch above compiled
+                        # synchronously (remote-helper time included —
+                        # invisible to the jax.monitoring compile hook)
+                        tel.emit("compile", duration_s=round(t2 - t1, 3),
+                                 source="first_step_latency")
+
+                    do_ckpt = global_step % ckpt_frequency == 0
+                    do_val = global_step % validation_frequency == 0
+                    if do_ckpt or do_val or guard.requested:
+                        # flush the in-flight metrics first so validation
+                        # scalars and the checkpoint agree on the step axis
+                        flush_pending()
+                    if guard.requested:
+                        preempted = True
+                        break
+                    if do_ckpt:
+                        ckpt = save_train_state(
+                            cfg.ckpt_dir, cfg.name, state, step=global_step,
+                            config_digest=run_digest,
+                            keep_last=cfg.ckpt_keep_last,
+                            keep_every=cfg.ckpt_keep_every)
+                        logger.info("saved %s", ckpt)
+                        tel.checkpoint(global_step, ckpt)
+                    if do_val:
+                        variables_host = jax.device_get(state.variables)
+                        if validation_predictor is None:
+                            from raft_stereo_tpu.inference import (
+                                StereoPredictor)
+                            validation_predictor = StereoPredictor(
+                                model_cfg, variables_host,
+                                valid_iters=cfg.valid_iters)
+                        else:  # keep the jit cache, refresh only the weights
+                            validation_predictor.variables = variables_host
+                        results = _maybe_validate_things(
+                            validation_predictor, cfg)
+                        if results:
+                            log.write_dict(results)
+                        pps = tel.window_throughput()
+                        if pps is not None:
+                            logger.info("throughput: %.2f pairs/sec over "
+                                        "last window", pps)
+
+                flush_pending()
+                if preempted:
+                    final = save_train_state(
+                        cfg.ckpt_dir, cfg.name, state, step=global_step,
+                        config_digest=run_digest,
+                        keep_last=cfg.ckpt_keep_last,
+                        keep_every=cfg.ckpt_keep_every, reason="preempt")
+                    logger.warning(
+                        "preempted by %s at step %d: saved %s — resume "
+                        "with --restore_ckpt auto", guard.signame,
+                        global_step, final)
+                    tel.emit("preempt", signal=guard.signame,
+                             step=global_step)
+                    tel.checkpoint(global_step, final, reason="preempt")
+                else:
+                    final = save_train_state(
+                        cfg.ckpt_dir, cfg.name, state,
+                        config_digest=run_digest, reason="final")
+                    tel.checkpoint(global_step, final, reason="final")
+            except BaseException as e:
+                tel.error(e)
+                _emergency_checkpoint(e, state, cfg, tel, global_step,
+                                      run_digest)
+                tel.emit("run_end", steps=global_step - start_step,
+                         ok=False, step=global_step)
+                tel.close()
+                raise
+            finally:
+                log.close()
     tel.window_throughput()
     tel.emit("run_end", steps=global_step - start_step, ok=True,
-             step=global_step)
+             step=global_step,
+             **({"reason": "preempt"} if preempted else {}))
     tel.close()
     logger.info("training done: %s (telemetry: %s)", final, tel.events_path)
     return final

@@ -1,0 +1,500 @@
+#!/usr/bin/env python
+"""Fault-injection drill: prove the exact-resume claim with a kill, not a
+docstring.
+
+The fault-tolerance layer (training/resilience.py) claims that a killed
+training run, resumed with ``--restore_ckpt auto``, is INDISTINGUISHABLE
+from one that never stopped — bitwise-equal final params, same per-step
+loss trajectory on the event stream. This drill makes that claim a gate.
+Every leg drives the REAL CLI surface (``python -m raft_stereo_tpu.cli
+train``) as subprocesses over a tiny synthetic SceneFlow tree, on CPU,
+in-sandbox:
+
+* **sigterm** — run, SIGTERM at a randomized step (the preemption handler
+  saves a ``reason="preempt"`` checkpoint and exits 0), resume with
+  ``--restore_ckpt auto``, assert final params bitwise-equal to the
+  uninterrupted oracle and the assembled per-step loss stream identical.
+* **sigkill** — same, but SIGKILL (no chance to save): resume rolls back
+  to the last periodic checkpoint (``--checkpoint_frequency``), replays
+  the lost steps from the Philox-exact stream, and must still end
+  bitwise-equal to the oracle.
+* **corrupt** — SIGKILL a run, then truncate a file inside its newest
+  checkpoint: auto-resume must record ``ckpt_integrity ok=false`` for it,
+  fall back to the previous valid checkpoint, and still match the oracle.
+* **nan** — inject an all-NaN batch at a known step
+  (``RAFT_FAULT_NAN_STEP``): the device-side anomaly guard must skip that
+  optimizer update (``skipped_updates>0`` on the events), the run must
+  complete, and the final params must be finite.
+
+Each leg appends a JSON record to ``runs/fault_drill/drills.jsonl``
+through the shared obs/ sink; exit status is non-zero if any leg failed,
+so scripts/rehearse_round.py's ``fault`` leg can gate a round on it.
+
+Run: python scripts/fault_drill.py [--drills sigterm sigkill corrupt nan]
+     [--steps 6] [--ckpt-every 2] [--seed N] [--keep-work]
+"""
+
+import argparse
+import glob
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from raft_stereo_tpu.obs.events import append_json_log  # noqa: E402
+
+OUT = os.path.join(REPO, "runs", "fault_drill")
+LOG = os.path.join(OUT, "drills.jsonl")
+
+H, W = 48, 64  # synthetic frame size (the trainer-test shape)
+CHILD_TIMEOUT_S = 900.0
+
+
+# --- synthetic data ----------------------------------------------------------
+
+def make_sceneflow_tree(root, n=4):
+    """Tiny FlyingThings-layout tree (the tests' fixture, kept in sync by
+    tests/test_resilience.py::test_drill_tree_matches_loader)."""
+    import numpy as np
+    from PIL import Image
+
+    from raft_stereo_tpu.data import frame_utils
+
+    rng = np.random.default_rng(0)
+    for dstype in ("frames_cleanpass", "frames_finalpass"):
+        for side in ("left", "right"):
+            os.makedirs(os.path.join(root, "FlyingThings3D", dstype, "TRAIN",
+                                     "A", "0000", side), exist_ok=True)
+        os.makedirs(os.path.join(root, "FlyingThings3D", "disparity",
+                                 "TRAIN", "A", "0000", "left"), exist_ok=True)
+        for i in range(n):
+            for side in ("left", "right"):
+                img = rng.integers(0, 255, (H, W, 3), dtype=np.uint8)
+                Image.fromarray(img).save(os.path.join(
+                    root, "FlyingThings3D", dstype, "TRAIN", "A", "0000",
+                    side, f"{i:04d}.png"))
+            frame_utils.write_pfm(
+                os.path.join(root, "FlyingThings3D", "disparity", "TRAIN",
+                             "A", "0000", "left", f"{i:04d}.pfm"),
+                rng.uniform(0.5, 8, (H, W)).astype(np.float32))
+
+
+# --- child runs --------------------------------------------------------------
+
+def child_cmd(name, work, steps, ckpt_every, restore=None):
+    # ``name`` is "<base>@<leg>": the checkpoint run name (shared between a
+    # drill's kill and resume legs, so auto-resume finds the kill leg's
+    # checkpoints) vs the per-leg run_dir root (separate event streams)
+    base, leg = name.split("@")[0], name.split("@")[-1]
+    cmd = [sys.executable, "-m", "raft_stereo_tpu.cli", "train",
+           "--name", base,
+           "--data_root", os.path.join(work, "data"),
+           "--ckpt_dir", os.path.join(work, "ckpts", base),
+           "--run_dir", os.path.join(work, "runs", leg),
+           "--batch_size", "2", "--num_steps", str(steps),
+           "--image_size", str(H), str(W),
+           "--train_iters", "1", "--valid_iters", "1",
+           "--hidden_dims", "32", "32", "32",
+           "--validation_frequency", "1000000",
+           "--checkpoint_frequency", str(ckpt_every),
+           "--ckpt_keep_last", "0",
+           "--num_workers", "2", "--lr", "1e-4",
+           "--data_parallel", "1", "--stall_deadline_s", "0"]
+    if restore:
+        cmd += ["--restore_ckpt", restore]
+    return cmd
+
+
+def run_child(name, work, steps, ckpt_every, restore=None, env_extra=None,
+              kill=None, kill_step=None, require_checkpoints=0):
+    """Run one training child; optionally signal it once the event stream
+    shows ``kill_step``. Returns (returncode, run_dir, log_path)."""
+    # the ckpt_dir is shared between a drill's legs (keyed by the part
+    # before '@'), the run_dir is per leg (the part after '@')
+    base = name.split("@")[0]
+    leg = name.split("@")[-1]
+    run_dir = os.path.join(work, "runs", leg, base)
+    log_path = os.path.join(work, f"{leg}.log")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    # drill children run a 1-device mesh; drop any test-harness forcing of
+    # a virtual multi-device platform (pure speed, not correctness)
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    cmd = child_cmd(name, work, steps, ckpt_every, restore=restore)
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=log,
+                                stderr=subprocess.STDOUT, env=env)
+        try:
+            if kill is not None:
+                # the step event for step s lands while s+1 runs (lagged
+                # metrics fetch, trainer.py) — waiting for s-1 signals the
+                # child while it is executing ~step s, with the remaining
+                # steps as margin against the signal landing after the run
+                # already completed
+                seen = wait_for_step(
+                    os.path.join(run_dir, "events.jsonl"),
+                    max(kill_step - 1, 1), proc,
+                    require_checkpoints=require_checkpoints)
+                if seen is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+                    raise RuntimeError(
+                        f"{leg}: child exited (rc={proc.returncode}) before "
+                        f"reaching kill step {kill_step}")
+                proc.send_signal(kill)
+            rc = proc.wait(timeout=CHILD_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=30)
+            raise RuntimeError(f"{leg}: child timed out after "
+                               f"{CHILD_TIMEOUT_S:.0f}s (see {log_path})")
+    return rc, run_dir, log_path
+
+
+def wait_for_step(events_path, step, proc, timeout_s=CHILD_TIMEOUT_S,
+                  require_checkpoints=0):
+    """Poll a (possibly mid-write) events.jsonl until a step event with
+    ``step >= step`` appears; None when the child exits first.
+
+    ``require_checkpoints`` additionally waits for that many ``checkpoint``
+    events — the SIGKILL/corrupt drills must not fire before the
+    checkpoints they roll back to are durable on disk (the checkpoint
+    event is emitted only after the atomic rename published it)."""
+
+    def ready(events):
+        stepped = any(e.get("event") == "step" and e.get("step", 0) >= step
+                      for e in events)
+        ckpts = sum(e.get("event") == "checkpoint" for e in events)
+        return stepped and ckpts >= require_checkpoints
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if ready(read_events_lenient(events_path)):
+            return step
+        if proc.poll() is not None:
+            # one final read: the event may have landed as it exited
+            return step if ready(read_events_lenient(events_path)) else None
+        time.sleep(0.2)
+    raise RuntimeError(f"no step >= {step} within {timeout_s:.0f}s "
+                       f"in {events_path}")
+
+
+def read_events_lenient(path):
+    """Parse an events.jsonl, skipping unparseable lines — a SIGKILL can
+    truncate the final record mid-write, which is exactly the artifact
+    state this drill exists to exercise."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+# --- assertions --------------------------------------------------------------
+
+def load_ckpt_tree(path):
+    """Raw orbax restore of a checkpoint dir (manifest layout aware)."""
+    import orbax.checkpoint as ocp
+
+    from raft_stereo_tpu.training.resilience import checkpoint_state_dir
+    return ocp.PyTreeCheckpointer().restore(checkpoint_state_dir(path))
+
+
+def params_bitwise_equal(path_a, path_b):
+    import jax
+    import numpy as np
+
+    ta, tb = load_ckpt_tree(path_a), load_ckpt_tree(path_b)
+    la, sa = jax.tree.flatten(ta["params"])
+    lb, sb = jax.tree.flatten(tb["params"])
+    if sa != sb:
+        return False, "param tree structures differ"
+    for i, (a, b) in enumerate(zip(la, lb)):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return False, f"param leaf {i} differs"
+    return True, None
+
+
+def params_all_finite(path):
+    import jax
+    import numpy as np
+
+    tree = load_ckpt_tree(path)
+    return all(np.all(np.isfinite(np.asarray(l)))
+               for l in jax.tree.leaves(tree["params"]))
+
+
+def step_loss_map(events):
+    return {rec["step"]: rec["loss"] for rec in events
+            if rec.get("event") == "step" and "loss" in rec}
+
+
+def assert_stream_matches_oracle(oracle_events, run_events_list, steps):
+    """The assembled per-step loss stream of the interrupted run(s) must be
+    IDENTICAL to the oracle's — later runs override the replayed overlap
+    (which must itself match, or the final params could not be bitwise
+    equal)."""
+    oracle = step_loss_map(oracle_events)
+    assembled = {}
+    for events in run_events_list:
+        assembled.update(step_loss_map(events))
+    missing = [s for s in range(1, steps + 1) if s not in assembled]
+    if missing:
+        return False, f"steps missing from assembled event stream: {missing}"
+    diff = [s for s in range(1, steps + 1)
+            if assembled[s] != oracle.get(s)]
+    if diff:
+        return False, (f"loss differs from oracle at steps {diff}: "
+                       f"{[(assembled[s], oracle.get(s)) for s in diff[:3]]}")
+    return True, None
+
+
+def newest_step_ckpt(ckpt_dir, name):
+    import re
+    pat = re.compile(rf"^(\d+)_{re.escape(name)}$")
+    best, best_step = None, -1
+    for entry in os.listdir(ckpt_dir):
+        m = pat.match(entry)
+        if m and int(m.group(1)) > best_step:
+            best, best_step = os.path.join(ckpt_dir, entry), int(m.group(1))
+    return best, best_step
+
+
+# --- drills ------------------------------------------------------------------
+
+def drill_kill(work, oracle, steps, ckpt_every, kill_step, sig, leg):
+    """Shared body of the sigterm/sigkill drills."""
+    name = f"{leg}@{leg}-run1"
+    rc1, run_dir1, log1 = run_child(
+        name, work, steps, ckpt_every, kill=sig, kill_step=kill_step,
+        require_checkpoints=1 if sig == signal.SIGKILL else 0)
+    events1 = read_events_lenient(os.path.join(run_dir1, "events.jsonl"))
+    detail = {"kill_step": kill_step, "signal": sig.name, "rc1": rc1}
+    if sig == signal.SIGTERM:
+        if rc1 != 0:
+            return False, dict(detail, error=f"SIGTERM child rc={rc1} "
+                               f"(expected graceful 0); see {log1}")
+        if not any(e.get("event") == "preempt" for e in events1):
+            return False, dict(detail, error="no preempt event on record")
+        if not any(e.get("event") == "checkpoint"
+                   and e.get("reason") == "preempt" for e in events1):
+            return False, dict(detail,
+                               error="no reason=preempt checkpoint event")
+    else:
+        if rc1 == 0:
+            return False, dict(detail, error="SIGKILL child exited 0?!")
+
+    rc2, run_dir2, log2 = run_child(f"{leg}@{leg}-run2", work, steps,
+                                    ckpt_every, restore="auto")
+    detail["rc2"] = rc2
+    if rc2 != 0:
+        return False, dict(detail, error=f"resume rc={rc2}; see {log2}")
+    events2 = read_events_lenient(os.path.join(run_dir2, "events.jsonl"))
+    resume = [e for e in events2 if e.get("event") == "resume"]
+    if not resume:
+        return False, dict(detail, error="resumed run has no resume event")
+    detail["resumed_step"] = resume[0]["step"]
+    detail["resumed_from"] = resume[0]["path"]
+
+    ok, why = params_bitwise_equal(
+        os.path.join(work, "ckpts", "oracle", "oracle"),
+        os.path.join(work, "ckpts", leg, leg))
+    if not ok:
+        return False, dict(detail, error=f"final params: {why}")
+    oracle_events = read_events_lenient(
+        os.path.join(work, "runs", "oracle", "oracle", "events.jsonl"))
+    ok, why = assert_stream_matches_oracle(oracle_events,
+                                           [events1, events2], steps)
+    if not ok:
+        return False, dict(detail, error=why)
+    skipped = sum(e.get("skipped_updates", 0) for e in events1 + events2
+                  if e.get("event") == "step")
+    if skipped:
+        return False, dict(detail, error=f"unexpected skipped updates "
+                                         f"({skipped}) in a clean drill")
+    return True, detail
+
+
+def drill_corrupt(work, oracle, steps, ckpt_every):
+    """SIGKILL a run, truncate its newest checkpoint, resume: auto must
+    skip the corrupt one (ckpt_integrity ok=false), roll back to the
+    previous valid checkpoint and still match the oracle bitwise."""
+    # kill late enough that at least two periodic checkpoints exist (and
+    # wait for both checkpoint events: durable-on-disk, not just stepped)
+    kill_step = 2 * ckpt_every + 1
+    rc1, run_dir1, _log1 = run_child("corrupt@corrupt-run1", work, steps,
+                                     ckpt_every, kill=signal.SIGKILL,
+                                     kill_step=kill_step,
+                                     require_checkpoints=2)
+    ckpt_dir = os.path.join(work, "ckpts", "corrupt")
+    newest, newest_step = newest_step_ckpt(ckpt_dir, "corrupt")
+    detail = {"rc1": rc1, "corrupted": newest, "corrupted_step": newest_step}
+    if newest is None or newest_step < 2 * ckpt_every:
+        return False, dict(detail, error="fewer than two periodic "
+                                         "checkpoints before the kill")
+    # truncate the largest file in the newest checkpoint's state tree
+    files = [p for p in glob.glob(os.path.join(newest, "state", "**", "*"),
+                                  recursive=True) if os.path.isfile(p)]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "r+b") as f:
+        f.truncate(max(os.path.getsize(victim) // 2, 1))
+    # if the kill raced past the final save, drop the stepless final so the
+    # corrupted step checkpoint is genuinely the newest candidate
+    final_ckpt = os.path.join(ckpt_dir, "corrupt")
+    if os.path.isdir(final_ckpt):
+        shutil.rmtree(final_ckpt)
+
+    rc2, run_dir2, log2 = run_child("corrupt@corrupt-run2", work, steps,
+                                    ckpt_every, restore="auto")
+    detail["rc2"] = rc2
+    if rc2 != 0:
+        return False, dict(detail, error=f"resume rc={rc2}; see {log2}")
+    events2 = read_events_lenient(os.path.join(run_dir2, "events.jsonl"))
+    bad = [e for e in events2 if e.get("event") == "ckpt_integrity"
+           and not e.get("ok")]
+    if not any(e.get("path") == newest for e in bad):
+        return False, dict(detail, error="no ckpt_integrity ok=false for "
+                                         "the corrupted checkpoint")
+    resume = [e for e in events2 if e.get("event") == "resume"]
+    if not resume or resume[0]["step"] != newest_step - ckpt_every:
+        return False, dict(detail, error=f"expected rollback to step "
+                           f"{newest_step - ckpt_every}, resume events: "
+                           f"{resume}")
+    detail["rolled_back_to"] = resume[0]["step"]
+    ok, why = params_bitwise_equal(
+        os.path.join(work, "ckpts", "oracle", "oracle"),
+        os.path.join(work, "ckpts", "corrupt", "corrupt"))
+    if not ok:
+        return False, dict(detail, error=f"final params: {why}")
+    return True, detail
+
+
+def drill_nan(work, steps, ckpt_every, nan_step=3):
+    """Inject an all-NaN batch: the device guard must skip that update
+    (skipped_updates>0), the run must finish, params must stay finite."""
+    rc, run_dir, log = run_child(
+        "nan@nan-run", work, steps, ckpt_every,
+        env_extra={"RAFT_FAULT_NAN_STEP": str(nan_step)})
+    detail = {"rc": rc, "nan_step": nan_step}
+    if rc != 0:
+        return False, dict(detail, error=f"NaN run rc={rc} (the guard "
+                           f"should have survived it); see {log}")
+    events = read_events_lenient(os.path.join(run_dir, "events.jsonl"))
+    skipped = sum(e.get("skipped_updates", 0) for e in events
+                  if e.get("event") == "step")
+    detail["skipped_updates"] = skipped
+    if skipped <= 0:
+        return False, dict(detail, error="no skipped updates on record")
+    anomalies = [e for e in events if e.get("event") == "anomaly"
+                 and e.get("kind") == "nonfinite_grad"]
+    if not any(a.get("step") == nan_step for a in anomalies):
+        return False, dict(detail, error=f"no nonfinite_grad anomaly at "
+                           f"step {nan_step}: {anomalies}")
+    if not params_all_finite(os.path.join(work, "ckpts", "nan", "nan")):
+        return False, dict(detail, error="final params are not finite")
+    return True, detail
+
+
+# --- main --------------------------------------------------------------------
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Kill/corrupt/NaN fault drills over the real train CLI "
+                    "(see module doc)")
+    p.add_argument("--drills", nargs="+",
+                   default=["sigterm", "sigkill", "corrupt", "nan"],
+                   choices=["sigterm", "sigkill", "corrupt", "nan"])
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--ckpt-every", type=int, default=2)
+    p.add_argument("--seed", type=int, default=None,
+                   help="kill-step randomization seed (default: random, "
+                        "recorded in the drill log)")
+    p.add_argument("--keep-work", action="store_true",
+                   help="keep the work dir (child run artifacts) on success")
+    args = p.parse_args(argv)
+
+    seed = args.seed if args.seed is not None \
+        else random.SystemRandom().randrange(1 << 20)
+    rng = random.Random(seed)
+    os.makedirs(OUT, exist_ok=True)
+    work = os.path.join(OUT, "work")
+    if os.path.exists(work):
+        shutil.rmtree(work)
+    os.makedirs(work)
+    make_sceneflow_tree(os.path.join(work, "data"))
+
+    needs_oracle = {"sigterm", "sigkill", "corrupt"} & set(args.drills)
+    t0 = time.monotonic()
+    records = []
+    try:
+        if needs_oracle:
+            rc, _run_dir, log = run_child("oracle@oracle", work, args.steps,
+                                          args.ckpt_every)
+            if rc != 0:
+                raise RuntimeError(f"oracle run rc={rc}; see {log}")
+        for drill in args.drills:
+            t1 = time.monotonic()
+            try:
+                if drill in ("sigterm", "sigkill"):
+                    # randomized, but never past the last step (there must
+                    # be work left to lose); SIGKILL additionally never
+                    # before the first periodic checkpoint can exist —
+                    # an uncheckpointed SIGKILL legitimately restarts from
+                    # scratch, which proves nothing about rollback
+                    sig = (signal.SIGTERM if drill == "sigterm"
+                           else signal.SIGKILL)
+                    lo = 2 if sig == signal.SIGTERM else args.ckpt_every + 1
+                    kill_step = rng.randint(lo, max(args.steps - 3, lo))
+                    ok, detail = drill_kill(work, "oracle", args.steps,
+                                            args.ckpt_every, kill_step,
+                                            sig, drill)
+                elif drill == "corrupt":
+                    ok, detail = drill_corrupt(work, "oracle", args.steps,
+                                               args.ckpt_every)
+                else:
+                    ok, detail = drill_nan(work, args.steps,
+                                           args.ckpt_every)
+            except Exception as e:
+                ok, detail = False, {"error": f"{type(e).__name__}: {e}"}
+            records.append({"drill": drill, "ok": ok, "seed": seed,
+                            "steps": args.steps,
+                            "ckpt_every": args.ckpt_every,
+                            "wall_s": round(time.monotonic() - t1, 1),
+                            "detail": detail})
+            append_json_log(LOG, records[-1], stream=sys.stderr)
+    finally:
+        if all(r["ok"] for r in records) and records \
+                and not args.keep_work:
+            shutil.rmtree(work, ignore_errors=True)
+
+    ok = bool(records) and all(r["ok"] for r in records)
+    summary = {"drill": "summary", "ok": ok, "seed": seed,
+               "wall_s": round(time.monotonic() - t0, 1),
+               "legs": {r["drill"]: r["ok"] for r in records}}
+    append_json_log(LOG, summary, stream=sys.stderr)
+    print(("fault drill ok: " if ok else "FAULT DRILL FAILED: ")
+          + ", ".join(f"{r['drill']}={'ok' if r['ok'] else 'FAIL'}"
+                      for r in records))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,13 +49,20 @@ rehearsal:
   HEAD's lowerings — a new collective, a wgrad conv re-entering the
   backward loop or a >10% peak-bytes jump fails the leg; intentional
   structural changes re-bank with ``--update-fingerprint``.
+* **fault** — the fault-tolerance drill (r11): ``python
+  scripts/fault_drill.py`` — SIGTERM and SIGKILL kill→auto-resume drills
+  must end bitwise-identical to an uninterrupted oracle, the
+  corrupt-checkpoint drill must roll back to the previous valid
+  checkpoint, and the injected-NaN drill must survive via the device-side
+  anomaly guard. The exact-resume contract is a standing gate, not a
+  docstring claim.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
 the rehearsal can gate a round's end ritual.
 
 Run: python scripts/rehearse_round.py
-     [--legs bench multichip events compare scangrad lint fingerprint]
+     [--legs bench multichip events compare scangrad lint fingerprint fault]
      [--bench-budget S] [--multichip-budget S] [--baseline RUN_DIR]
 """
 
@@ -193,12 +200,13 @@ def main(argv=None):
                     "driver's budgets (see module doc)")
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint", "fingerprint"],
+                            "scangrad", "lint", "fingerprint", "fault"],
                    choices=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint", "fingerprint"])
+                            "scangrad", "lint", "fingerprint", "fault"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
+    p.add_argument("--fault-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -248,6 +256,12 @@ def main(argv=None):
             [sys.executable, "-m", "raft_stereo_tpu.cli", "lint",
              "--fingerprint"],
             args.fingerprint_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "fault" in args.legs:
+        records.append(run_leg(
+            "fault",
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "fault_drill.py")],
+            args.fault_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

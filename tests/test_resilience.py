@@ -1,0 +1,731 @@
+"""Fault tolerance: atomic checkpoints, auto-resume, anomaly guard, loader
+quarantine, and the resume-repositioning math (training/resilience.py;
+drill companion: scripts/fault_drill.py — the end-to-end kill/corrupt/NaN
+proofs run there, the unit contracts live here)."""
+
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.data.loader import Loader, infinite_batches
+from raft_stereo_tpu.obs.events import (SCHEMA_VERSION, make_record,
+                                        validate_record)
+from raft_stereo_tpu.training import resilience as rz
+from raft_stereo_tpu.training.checkpoint import (restore_train_state,
+                                                 save_train_state)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_state(seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "params": {"w": (scale * rng.standard_normal((4, 3))
+                         ).astype(np.float32),
+                   "b": np.zeros((3,), np.float32)},
+        "opt_state": {"mu": np.zeros((4, 3), np.float32)},
+        "step": np.int32(0),
+    }
+
+
+def corrupt_one_file(ckpt_path, mode="flip"):
+    """Damage the largest file inside a checkpoint's state tree."""
+    files = []
+    for dirpath, _d, filenames in os.walk(os.path.join(ckpt_path, "state")):
+        files += [os.path.join(dirpath, f) for f in filenames]
+    victim = max(files, key=os.path.getsize)
+    if mode == "truncate":
+        with open(victim, "r+b") as f:
+            f.truncate(max(os.path.getsize(victim) // 2, 1))
+    else:
+        with open(victim, "r+b") as f:
+            f.seek(0)
+            byte = f.read(1)
+            f.seek(0)
+            f.write(bytes([byte[0] ^ 0xFF]))
+    return victim
+
+
+# --- atomic checkpoint protocol ----------------------------------------------
+
+def test_atomic_save_verify_restore_roundtrip(tmp_path):
+    state = tiny_state()
+    path = save_train_state(str(tmp_path), "run", state, step=7,
+                            config_digest="abcd1234")
+    assert path.endswith("7_run")
+    manifest = rz.load_manifest(path)
+    assert manifest["step"] == 7
+    assert manifest["config_digest"] == "abcd1234"
+    assert manifest["tree_hash"] == rz.tree_structure_hash(state)
+    assert manifest["files"]  # per-file size+crc inventory
+    ok, reason, _ = rz.verify_checkpoint(
+        path, config_digest="abcd1234",
+        tree_hash=rz.tree_structure_hash(state))
+    assert ok, reason
+    restored = restore_train_state(path, tiny_state(seed=99))
+    np.testing.assert_array_equal(restored["params"]["w"],
+                                  state["params"]["w"])
+    # no temp dirs left behind
+    assert not [e for e in os.listdir(tmp_path) if e.startswith(".")]
+
+
+@pytest.mark.parametrize("mode", ["truncate", "flip"])
+def test_verify_detects_damage(tmp_path, mode):
+    path = save_train_state(str(tmp_path), "run", tiny_state(), step=3)
+    ok, _, _ = rz.verify_checkpoint(path)
+    assert ok
+    corrupt_one_file(path, mode=mode)
+    ok, reason, _ = rz.verify_checkpoint(path)
+    assert not ok
+    assert ("size mismatch" if mode == "truncate" else "crc") in reason
+
+
+def test_verify_rejects_digest_and_structure_mismatch(tmp_path):
+    state = tiny_state()
+    path = save_train_state(str(tmp_path), "run", state, step=3,
+                            config_digest="aaaa")
+    ok, reason, _ = rz.verify_checkpoint(path, config_digest="bbbb")
+    assert not ok and "config digest" in reason
+    other = {"params": {"w": np.zeros((2, 2), np.float32)}}
+    ok, reason, _ = rz.verify_checkpoint(
+        path, tree_hash=rz.tree_structure_hash(other))
+    assert not ok and "structure" in reason
+
+
+def test_auto_resume_skips_corrupt_newest(tmp_path):
+    state = tiny_state()
+    old = save_train_state(str(tmp_path), "run", state, step=2,
+                           config_digest="d1")
+    new = save_train_state(str(tmp_path), "run", state, step=4,
+                           config_digest="d1")
+    corrupt_one_file(new, mode="truncate")
+    best, reports = rz.find_latest_valid(str(tmp_path), "run",
+                                         config_digest="d1")
+    assert best == old
+    assert [r["ok"] for r in reports] == [False, True]
+    assert reports[0]["path"] == new and "size mismatch" in reports[0][
+        "reason"]
+    # a missing manifest (legacy/torn checkpoint) is skipped, not fatal
+    os.remove(os.path.join(new, "MANIFEST.json"))
+    best2, reports2 = rz.find_latest_valid(str(tmp_path), "run")
+    assert best2 == old and "manifest" in reports2[0]["reason"]
+
+
+def test_auto_resume_skips_foreign_digest(tmp_path):
+    state = tiny_state()
+    save_train_state(str(tmp_path), "other-config", state, step=9)
+    theirs = save_train_state(str(tmp_path), "run", state, step=9,
+                              config_digest="theirs")
+    mine = save_train_state(str(tmp_path), "run", state, step=5,
+                            config_digest="mine")
+    # rotate-protection renamed nothing (different steps); auto-resume must
+    # pick MY step-5 checkpoint over the foreign step-9 one
+    best, reports = rz.find_latest_valid(str(tmp_path), "run",
+                                         config_digest="mine")
+    assert best == mine
+    assert reports[0]["path"] == theirs and not reports[0]["ok"]
+
+
+def test_clobber_same_digest_overwrites_in_place(tmp_path):
+    a = tiny_state(seed=1)
+    b = tiny_state(seed=2)
+    p1 = save_train_state(str(tmp_path), "run", a, config_digest="same")
+    p2 = save_train_state(str(tmp_path), "run", b, config_digest="same")
+    assert p1 == p2
+    assert not os.path.exists(p1 + ".bak")
+    restored = restore_train_state(p1, tiny_state(seed=99))
+    np.testing.assert_array_equal(restored["params"]["w"], b["params"]["w"])
+
+
+def test_clobber_mismatched_digest_rotates_to_bak(tmp_path):
+    a = tiny_state(seed=1)
+    b = tiny_state(seed=2)
+    p1 = save_train_state(str(tmp_path), "run", a, config_digest="old-run")
+    p2 = save_train_state(str(tmp_path), "run", b, config_digest="new-run")
+    assert p1 == p2
+    # the old run's checkpoint survived, rotated aside
+    bak = p1 + ".bak"
+    assert os.path.isdir(bak)
+    old = restore_train_state(bak, tiny_state(seed=99))
+    np.testing.assert_array_equal(old["params"]["w"], a["params"]["w"])
+    new = restore_train_state(p2, tiny_state(seed=99))
+    np.testing.assert_array_equal(new["params"]["w"], b["params"]["w"])
+
+
+def test_retention_keeps_last_k_and_every_nth(tmp_path):
+    state = tiny_state()
+    for step in (2, 4, 6, 8, 10):
+        save_train_state(str(tmp_path), "run", state, step=step)
+    deleted = rz.apply_retention(str(tmp_path), "run", keep_last=2,
+                                 keep_every=4)
+    kept = sorted(e for e in os.listdir(tmp_path) if e.endswith("_run"))
+    # newest two (8, 10) plus the multiples of 4 (4, 8); 2 and 6 swept
+    assert kept == ["10_run", "4_run", "8_run"]
+    assert sorted(os.path.basename(d) for d in deleted) == ["2_run",
+                                                            "6_run"]
+
+
+def test_retention_rides_save(tmp_path):
+    state = tiny_state()
+    for step in (1, 2, 3, 4):
+        save_train_state(str(tmp_path), "run", state, step=step,
+                         keep_last=2)
+    kept = sorted(e for e in os.listdir(tmp_path) if e.endswith("_run"))
+    assert kept == ["3_run", "4_run"]
+
+
+def test_config_digest_sensitivity():
+    m1, t1 = RAFTStereoConfig(), TrainConfig()
+    assert rz.config_digest(m1, t1) == rz.config_digest(
+        RAFTStereoConfig(), TrainConfig())
+    # run-identity fields move the digest ...
+    assert rz.config_digest(m1, t1) != rz.config_digest(
+        RAFTStereoConfig(hidden_dims=(96, 96, 96)), t1)
+    assert rz.config_digest(m1, t1) != rz.config_digest(
+        m1, TrainConfig(lr=1e-3))
+    # ... cosmetic ones (name, dirs, cadence) do not: renaming a run or
+    # moving its artifacts must not orphan its checkpoints
+    assert rz.config_digest(m1, t1) == rz.config_digest(
+        m1, TrainConfig(name="other", ckpt_dir="elsewhere",
+                        validation_frequency=123,
+                        checkpoint_frequency=7))
+
+
+def test_tree_structure_hash_tracks_structure():
+    a = tiny_state()
+    assert rz.tree_structure_hash(a) == rz.tree_structure_hash(tiny_state())
+    b = tiny_state()
+    b["params"]["w"] = b["params"]["w"].astype(np.float16)
+    assert rz.tree_structure_hash(a) != rz.tree_structure_hash(b)
+    c = tiny_state()
+    c["params"]["extra"] = np.zeros(1, np.float32)
+    assert rz.tree_structure_hash(a) != rz.tree_structure_hash(c)
+
+
+def test_state_is_finite():
+    good = tiny_state()
+    assert rz.state_is_finite(good)
+    bad = tiny_state()
+    bad["params"]["w"][0, 0] = np.nan
+    assert not rz.state_is_finite(bad)
+
+
+# --- signals + anomaly policy ------------------------------------------------
+
+def test_signal_guard_records_and_restores():
+    prev = signal.getsignal(signal.SIGTERM)
+    with rz.SignalGuard() as guard:
+        assert guard.installed
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.requested
+        assert guard.signame == "SIGTERM"
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+class _Bus:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **payload):
+        self.events.append(dict(payload, event=event))
+
+
+def test_anomaly_policy_halts_after_consecutive_skips():
+    bus = _Bus()
+    policy = rz.AnomalyPolicy(max_consecutive=3, telemetry=bus)
+    policy.observe(True, 1, grad_norm=float("nan"))
+    policy.observe(True, 2)
+    policy.observe(False, 3)  # streak broken: counter resets
+    policy.observe(True, 4)
+    policy.observe(True, 5)
+    with pytest.raises(rz.AnomalyHalt):
+        policy.observe(True, 6)
+    kinds = [e["event"] + ":" + e["kind"] for e in bus.events]
+    assert kinds.count("anomaly:nonfinite_grad") == 5
+    assert kinds[-1] == "anomaly:halt"
+    assert policy.total == 5
+
+
+def test_anomaly_policy_zero_never_halts():
+    policy = rz.AnomalyPolicy(max_consecutive=0)
+    for step in range(1, 50):
+        policy.observe(True, step)
+    assert policy.total == 49
+
+
+# --- schema v5 ---------------------------------------------------------------
+
+def test_schema_v5_events_validate():
+    assert SCHEMA_VERSION == 5
+    recs = [
+        make_record("preempt", signal="SIGTERM", step=123),
+        make_record("resume", step=120, path="/ckpts/120_run"),
+        make_record("ckpt_integrity", path="/ckpts/120_run", ok=False,
+                    reason="crc mismatch"),
+        make_record("anomaly", kind="nonfinite_grad", step=7,
+                    grad_norm=None, consecutive=1, skipped_total=1),
+    ]
+    for rec in recs:
+        assert validate_record(rec) == [], rec
+    # required fields enforced
+    assert validate_record(make_record("preempt", step=1)) != []
+    # a v4-stamped v5 event is schema drift
+    stale = make_record("resume", step=1, path="x")
+    stale["schema"] = 4
+    assert any("introduced in schema 5" in e for e in validate_record(stale))
+    # v4 artifacts still lint clean
+    old = make_record("lint", source="x", findings=0)
+    old["schema"] = 4
+    assert validate_record(old) == []
+
+
+# --- loader I/O resilience ---------------------------------------------------
+
+class ArrayDataset:
+    """Deterministic rng-consuming stub: sample i is f(i, rng)."""
+
+    def __init__(self, n=8, fail=(), fail_times=None):
+        self.n = n
+        self.fail = set(fail)
+        # index -> remaining failures (None = fail forever)
+        self.fail_times = dict(fail_times or {})
+        self.attempts = {}
+
+    def __len__(self):
+        return self.n
+
+    def sample(self, index, rng):
+        self.attempts[index] = self.attempts.get(index, 0) + 1
+        if index in self.fail:
+            raise IOError(f"decode failed for {index}")
+        remaining = self.fail_times.get(index)
+        if remaining:
+            self.fail_times[index] = remaining - 1
+            raise IOError(f"transient failure for {index}")
+        jitter = rng.random(3).astype(np.float32)
+        return {
+            "image1": np.full((4, 6, 3), index, np.float32) + jitter[0],
+            "image2": np.full((4, 6, 3), index, np.float32) + jitter[1],
+            "flow": np.full((4, 6, 1), -index, np.float32) + jitter[2],
+            "valid": np.ones((4, 6), np.float32),
+        }
+
+
+def collect(loader, n):
+    out = []
+    stream = infinite_batches(loader)
+    for _ in range(n):
+        out.append(next(stream))
+    return out
+
+
+def batches_equal(a, b):
+    return all(np.array_equal(x[k], y[k])
+               for x, y in zip(a, b) for k in x)
+
+
+def test_loader_retry_recovers_transient_failures():
+    clean = collect(Loader(ArrayDataset(), 2, seed=3, num_workers=2,
+                           retry_backoff_s=0.001), 8)
+    flaky_ds = ArrayDataset(fail_times={1: 1, 5: 2})
+    flaky = Loader(flaky_ds, 2, seed=3, num_workers=2, decode_retries=2,
+                   retry_backoff_s=0.001)
+    got = collect(flaky, 8)
+    assert batches_equal(clean, got)
+    assert not flaky.quarantined  # retries absorbed it; no substitution
+
+
+def test_loader_quarantine_is_deterministic_and_philox_preserving():
+    n_batches = 8
+    clean = collect(Loader(ArrayDataset(), 2, seed=3, num_workers=2), n_batches)
+    records = []
+    broken = Loader(ArrayDataset(fail=(5,)), 2, seed=3, num_workers=2,
+                    decode_retries=1, retry_backoff_s=0.001)
+    broken.quarantine_hook = records.append
+    got = collect(broken, n_batches)
+    assert broken.quarantined and records
+    rec = broken.quarantined[0]
+    assert rec["index"] == 5 and rec["substitute"] == 6
+    # every slot that did NOT hit the broken sample is bitwise identical to
+    # the clean stream (the Philox keys of other slots were never touched)
+    diff_fields = 0
+    for cb, gb in zip(clean, got):
+        for k in cb:
+            same = np.array_equal(cb[k], gb[k])
+            if not same:
+                diff_fields += 1
+    # index 5 appears once per epoch; 8 batches of 2 over 8 samples = 2
+    # epochs -> 2 substituted slots, 3 differing fields each (valid is
+    # all-ones either way)
+    assert diff_fields == 2 * 3
+    # the substitution itself is deterministic: a second run quarantines
+    # identically
+    broken2 = Loader(ArrayDataset(fail=(5,)), 2, seed=3, num_workers=2,
+                     decode_retries=1, retry_backoff_s=0.001)
+    got2 = collect(broken2, n_batches)
+    assert batches_equal(got, got2)
+
+
+def test_loader_all_broken_fails_fast():
+    ds = ArrayDataset(n=4, fail=(0, 1, 2, 3))
+    loader = Loader(ds, 2, seed=0, num_workers=1, decode_retries=0,
+                    retry_backoff_s=0.001)
+    with pytest.raises(IOError):
+        collect(loader, 1)
+
+
+# --- resume repositioning math (the Philox exact-resume contract) ------------
+
+def reposition(loader, step):
+    """The trainer's restore-time formula (trainer.py)."""
+    loader.epoch = step // max(len(loader), 1)
+    loader.start_batch = step % max(len(loader), 1)
+
+
+@pytest.mark.parametrize("n,batch", [(8, 2), (8, 8), (6, 4)])
+def test_resume_repositioning_matches_uninterrupted_stream(n, batch):
+    """Pin loader.epoch/start_batch reconstruction against ground truth:
+    resuming at ANY step reproduces the uninterrupted stream's suffix,
+    including epoch boundaries, len(loader)==1 (n==batch) and the
+    drop_last partial-epoch case (6, 4)."""
+    total = 10
+    oracle = collect(Loader(ArrayDataset(n=n), batch, seed=11,
+                            num_workers=2), total)
+    for step in range(total):
+        resumed = Loader(ArrayDataset(n=n), batch, seed=11, num_workers=2)
+        reposition(resumed, step)
+        got = collect(resumed, total - step)
+        assert batches_equal(oracle[step:], got), f"resume at step {step}"
+
+
+def test_resume_repositioning_counts_micro_steps_under_grad_accum():
+    """grad_accum_steps>1 must NOT change the mapping: state.step counts
+    micro-steps (every consumed batch advances it, trainer.py), so the
+    formula is accumulation-agnostic — resuming at micro-step s always
+    lands on batch s of the stream."""
+    total, accum = 9, 3
+    oracle = collect(Loader(ArrayDataset(), 2, seed=5, num_workers=2), total)
+    # an interrupted run that stopped mid-accumulation-window (micro-step 7
+    # inside the third window of 3)
+    micro_step = 7
+    assert micro_step % accum != 0
+    resumed = Loader(ArrayDataset(), 2, seed=5, num_workers=2)
+    reposition(resumed, micro_step)
+    got = collect(resumed, total - micro_step)
+    assert batches_equal(oracle[micro_step:], got)
+
+
+# --- graftlint follow-through: the naive NaN check vs the shipped guard ------
+
+NAIVE_HOST_CHECK = '''
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def train_step(state, batch):
+    grads = jax.grad(lambda p: jnp.sum(p * batch))(state)
+    grad_norm = optax.global_norm(grads)
+    if float(grad_norm) > 0 and bool(jnp.isfinite(grad_norm)):
+        return state - grads
+    return state
+
+
+step = jax.jit(train_step)
+'''
+
+
+def test_tracer_unsafe_fires_on_naive_host_nan_check():
+    """The tempting implementation — `float(grad_norm)` per step — is a
+    host sync per step (and a ConcretizationTypeError under jit); the AST
+    engine must flag it."""
+    from raft_stereo_tpu.analysis.ast_rules import lint_source
+    findings = lint_source(NAIVE_HOST_CHECK, "fixture/naive_guard.py")
+    unsafe = [f for f in findings if f.rule == "tracer-unsafe"]
+    assert len(unsafe) >= 2  # float() and bool()
+    assert all(f.severity == "error" for f in unsafe)
+
+
+def test_shipped_guard_module_is_tracer_safe():
+    """training/state.py (the lax.cond guard) and resilience.py lint clean
+    under the same engine."""
+    from raft_stereo_tpu.analysis.ast_rules import lint_source
+    for rel in ("raft_stereo_tpu/training/state.py",
+                "raft_stereo_tpu/training/resilience.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            findings = lint_source(f.read(), rel)
+        errors = [f for f in findings
+                  if f.rule in ("tracer-unsafe", "wall-clock")
+                  and f.severity == "error"]
+        assert errors == [], [f.message for f in errors]
+
+
+@pytest.fixture(scope="module")
+def guarded_step_setup():
+    """One tiny model + optimizer shared by the device-guard tests."""
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.training.optim import fetch_optimizer
+    from raft_stereo_tpu.training.state import TrainState
+
+    model_cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    cfg = TrainConfig(num_steps=10, batch_size=1)
+    model, variables = init_model(jax.random.PRNGKey(0), model_cfg,
+                                  (1, 32, 48, 3))
+    tx = fetch_optimizer(cfg)
+    state = TrainState.create(variables, tx)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)),
+                              jnp.float32),
+        "image2": jnp.asarray(rng.uniform(0, 255, (1, 32, 48, 3)),
+                              jnp.float32),
+        "flow": jnp.asarray(rng.uniform(-8, 0, (1, 32, 48, 1)),
+                            jnp.float32),
+        "valid": jnp.ones((1, 32, 48), jnp.float32),
+    }
+    return model, tx, state, batch
+
+
+def test_minimal_cond_guard_is_host_sync_clean():
+    """The guard's shape — global-norm finiteness into a lax.cond over
+    the update — introduces no host-sync primitive (cheap structural
+    check; the REAL train_step[update] lowering is linted by the graph
+    engine in `cli lint`, a rehearsal leg, and exercised end-to-end by
+    scripts/fault_drill.py)."""
+    import optax
+
+    from raft_stereo_tpu.analysis.graph_rules import (GraphTarget,
+                                                      rule_host_sync)
+
+    def guarded_update(params, grads):
+        gnorm = optax.global_norm(grads)
+        ok = jnp.isfinite(gnorm)
+        return jax.lax.cond(
+            ok, lambda o: jax.tree.map(lambda p, g: p - 0.1 * g, *o),
+            lambda o: o[0], (params, grads)), gnorm
+
+    tree = {"w": jnp.ones((4, 3)), "b": jnp.zeros((3,))}
+    jaxpr = jax.make_jaxpr(guarded_update)(tree, tree)
+    target = GraphTarget(name="fixture", cfg=RAFTStereoConfig(),
+                         closed_jaxpr=jaxpr)
+    assert rule_host_sync(target, {}) == []
+    # and the cond is actually there (the skip is a real branch, not DCE'd)
+    from raft_stereo_tpu.obs.xla import iter_eqns
+    prims = {e.primitive.name for e, _ in iter_eqns(jaxpr)}
+    assert "cond" in prims
+
+
+@pytest.mark.slow  # full (tiny-shape) train-step compile, ~40 s XLA-CPU
+def test_device_guard_skips_nan_update_without_host_sync(guarded_step_setup):
+    """The shipped guard on the real model: lax.cond on device — a NaN
+    batch skips the optimizer update (params bitwise untouched, step still
+    advances, skipped_updates=1 in metrics), a good batch applies it; and
+    the guarded jaxpr contains no host-sync primitive. (The fault drill
+    proves the same end-to-end through the CLI; this is the in-process
+    pin.)"""
+    from raft_stereo_tpu.analysis.graph_rules import (GraphTarget,
+                                                      rule_host_sync)
+    from raft_stereo_tpu.training.state import make_train_step
+
+    model, tx, state, batch = guarded_step_setup
+    step = jax.jit(make_train_step(model, tx, 1, anomaly_guard=True))
+
+    # host-sync rule stays green over the guarded lowering
+    jaxpr = jax.make_jaxpr(step)(state, batch)
+    target = GraphTarget(name="train_step[update]",
+                         cfg=RAFTStereoConfig(hidden_dims=(32, 32, 32)),
+                         closed_jaxpr=jaxpr)
+    assert rule_host_sync(target, {}) == []
+
+    s1, m1 = step(state, batch)
+    assert float(m1["skipped_updates"]) == 0.0
+    assert np.isfinite(float(m1["grad_norm"]))
+    p_good = jax.device_get(s1.params)
+
+    nan_batch = dict(batch, image1=jnp.full_like(batch["image1"], jnp.nan))
+    s2, m2 = step(s1, nan_batch)
+    assert float(m2["skipped_updates"]) == 1.0
+    assert not np.isfinite(float(m2["grad_norm"]))
+    assert int(s2.step) == 2  # consumed-batch counter still advances
+    for a, b in zip(jax.tree.leaves(p_good),
+                    jax.tree.leaves(jax.device_get(s2.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # and a subsequent good batch trains on, with finite params
+    s3, m3 = step(s2, batch)
+    assert float(m3["skipped_updates"]) == 0.0
+    assert rz.state_is_finite(s3)
+
+
+def test_host_sync_rule_fires_on_callback_guard():
+    """The other naive alternative — checking finiteness through a host
+    callback inside the step — must trip graftlint's host-sync rule."""
+    from raft_stereo_tpu.analysis.graph_rules import (GraphTarget,
+                                                      rule_host_sync)
+
+    def callback_guard(x):
+        ok = jax.pure_callback(lambda v: np.isfinite(v),
+                               jax.ShapeDtypeStruct((), np.bool_),
+                               jnp.sum(x))
+        return jnp.where(ok, x, 0.0)
+
+    jaxpr = jax.make_jaxpr(callback_guard)(jnp.ones((4,)))
+    target = GraphTarget(name="fixture", cfg=RAFTStereoConfig(),
+                         closed_jaxpr=jaxpr)
+    findings = rule_host_sync(target, {})
+    assert findings and findings[0].severity == "error"
+    assert "pure_callback" in findings[0].message
+
+
+# --- emergency checkpoint on crash (the except-BaseException satellite) ------
+
+class _Tel(_Bus):
+    def checkpoint(self, step, path, **payload):
+        self.events.append(dict(payload, event="checkpoint", step=step,
+                                path=path))
+
+
+def test_emergency_checkpoint_saves_finite_state(tmp_path):
+    from raft_stereo_tpu.training.trainer import _emergency_checkpoint
+
+    cfg = TrainConfig(name="crashy", ckpt_dir=str(tmp_path))
+    tel = _Tel()
+    path = _emergency_checkpoint(RuntimeError("boom"), tiny_state(), cfg,
+                                 tel, 17, "dig")
+    assert path is not None and path.endswith("17_crashy")
+    assert tel.events[-1]["event"] == "checkpoint"
+    assert tel.events[-1]["reason"] == "crash"
+    ok, reason, manifest = rz.verify_checkpoint(path, config_digest="dig")
+    assert ok, reason
+    assert manifest["reason"] == "crash"
+    # --restore_ckpt auto would resume from it
+    best, _ = rz.find_latest_valid(str(tmp_path), "crashy",
+                                   config_digest="dig")
+    assert best == path
+
+
+def test_emergency_checkpoint_refuses_nonfinite_state(tmp_path):
+    from raft_stereo_tpu.training.trainer import _emergency_checkpoint
+
+    bad = tiny_state()
+    bad["params"]["w"][0, 0] = np.inf
+    cfg = TrainConfig(name="crashy", ckpt_dir=str(tmp_path))
+    tel = _Tel()
+    path = _emergency_checkpoint(RuntimeError("boom"), bad, cfg, tel, 17,
+                                 "dig")
+    assert path is None
+    assert not os.listdir(tmp_path)  # nothing (not even a temp) left
+    assert tel.events[-1] == {"event": "anomaly", "kind": "nonfinite_state",
+                              "step": 17}
+
+
+def test_emergency_checkpoint_skipped_on_anomaly_halt(tmp_path):
+    from raft_stereo_tpu.training.trainer import _emergency_checkpoint
+
+    cfg = TrainConfig(name="crashy", ckpt_dir=str(tmp_path))
+    tel = _Tel()
+    path = _emergency_checkpoint(rz.AnomalyHalt("poisoned"), tiny_state(),
+                                 cfg, tel, 17, "dig")
+    # rollback-by-design: the halt must leave the last durable checkpoint
+    # as the newest one, so nothing is saved and nothing emitted
+    assert path is None and tel.events == []
+    assert not os.listdir(tmp_path)
+
+
+def _make_sceneflow_tree(root):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_trainer import _make_sceneflow_tree as mk
+    mk(root)
+
+
+@pytest.mark.slow
+def test_crash_saves_emergency_checkpoint(tmp_path, monkeypatch):
+    """A crash mid-run (here: validation raising) must leave a
+    reason="crash" checkpoint holding the latest state, then re-raise —
+    and --restore_ckpt auto must be able to resume from it."""
+    from raft_stereo_tpu.training import trainer as trainer_mod
+
+    _make_sceneflow_tree(tmp_path)
+
+    def boom(predictor, cfg):
+        raise RuntimeError("injected validation crash")
+
+    monkeypatch.setattr(trainer_mod, "_maybe_validate_things", boom)
+    model_cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    cfg = TrainConfig(
+        name="crashy", batch_size=2, num_steps=4, image_size=(48, 64),
+        train_iters=1, valid_iters=1, data_root=str(tmp_path),
+        ckpt_dir=str(tmp_path / "ckpts"), validation_frequency=2,
+        checkpoint_frequency=100, num_workers=2, data_parallel=1,
+        seq_parallel=1, lr=1e-4, run_dir=str(tmp_path / "runs"),
+        stall_deadline_s=None)
+    with pytest.raises(RuntimeError, match="injected validation crash"):
+        trainer_mod.train(model_cfg, cfg)
+
+    from raft_stereo_tpu.obs import read_events
+    events = read_events(str(tmp_path / "runs" / "crashy" / "events.jsonl"))
+    crash = [e for e in events if e["event"] == "checkpoint"
+             and e.get("reason") == "crash"]
+    assert crash and crash[0]["step"] == 2
+    assert os.path.isdir(crash[0]["path"])
+    ok, reason, manifest = rz.verify_checkpoint(
+        crash[0]["path"], config_digest=rz.config_digest(model_cfg, cfg))
+    assert ok, reason
+    assert manifest["reason"] == "crash"
+    end = events[-1]
+    assert end["event"] == "run_end" and end["ok"] is False
+
+    # auto-resume picks the emergency checkpoint up and finishes the run
+    cfg2 = TrainConfig(**{**dataclasses_asdict(cfg),
+                          "restore_ckpt": "auto",
+                          "validation_frequency": 100,
+                          "run_dir": str(tmp_path / "runs2")})
+    final = trainer_mod.train(model_cfg, cfg2)
+    events2 = read_events(
+        str(tmp_path / "runs2" / "crashy" / "events.jsonl"))
+    resume = next(e for e in events2 if e["event"] == "resume")
+    assert resume["step"] == 2 and resume["path"] == crash[0]["path"]
+    integ = [e for e in events2 if e["event"] == "ckpt_integrity"]
+    assert integ and integ[-1]["ok"] is True
+    restored = restore_train_state(final, None)
+    assert int(np.asarray(restored["step"])) == 4
+
+
+def dataclasses_asdict(cfg):
+    import dataclasses
+    return dataclasses.asdict(cfg)
+
+
+# --- drill plumbing ----------------------------------------------------------
+
+def test_drill_record_log_and_tree_fixture(tmp_path):
+    """The drill's synthetic tree is loadable by the real dataloader (kept
+    in sync with the trainer tests' fixture), and a green drill record
+    exists under runs/fault_drill/ once the drill has run in this repo."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fault_drill", os.path.join(REPO, "scripts", "fault_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+    drill.make_sceneflow_tree(str(tmp_path), n=2)
+    from raft_stereo_tpu.data.datasets import fetch_dataloader
+    cfg = TrainConfig(batch_size=2, image_size=(48, 64),
+                      data_root=str(tmp_path), num_workers=1)
+    loader = fetch_dataloader(cfg)
+    assert len(loader) >= 1
+    # the banked drill evidence (written by scripts/fault_drill.py runs)
+    log = os.path.join(REPO, "runs", "fault_drill", "drills.jsonl")
+    if os.path.exists(log):
+        with open(log) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        summaries = [r for r in records if r.get("drill") == "summary"]
+        assert summaries and summaries[-1]["ok"]
