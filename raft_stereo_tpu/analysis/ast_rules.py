@@ -41,14 +41,15 @@ from raft_stereo_tpu.analysis.findings import Finding
 
 #: current semantic version per rule (baseline entries record the version
 #: they suppress; a bump flags them stale — findings.apply_baseline).
-#: cli-drift is v2: the PR-6 extension to the evaluate_stereo/demo parser
-#: surfaces and the bench config-constructor call sites widened what the
-#: rule checks, so v1-era suppressions no longer mean what they said.
+#: cli-drift is v3: v2 extended the rule to the evaluate_stereo/demo
+#: parser surfaces and the bench config-constructor call sites; v3 adds
+#: the serving surfaces (build_serve_parser/build_loadtest_parser), so
+#: earlier suppressions no longer mean what they said.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 2,
+    "cli-drift": 3,
 }
 
 # Call names (last attribute segment) that trace their function arguments.
@@ -469,6 +470,12 @@ def check_cli_config_drift(cli_path: str, relpath: str) -> List[Finding]:
 ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("build_eval_parser", ("raft_stereo_tpu/cli.py", "evaluate_stereo.py")),
     ("build_demo_parser", ("raft_stereo_tpu/cli.py", "demo.py")),
+    # serving surfaces (rule v3): the serve/loadtest mains consume most
+    # flags in cli.py itself; loadtest's trace knobs are read by the
+    # driver module
+    ("build_serve_parser", ("raft_stereo_tpu/cli.py",)),
+    ("build_loadtest_parser", ("raft_stereo_tpu/cli.py",
+                               "raft_stereo_tpu/serve/loadtest.py")),
 )
 
 #: modules whose own argparse surface must be self-consumed, and whose

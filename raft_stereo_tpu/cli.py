@@ -292,6 +292,262 @@ def build_demo_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def add_serve_args(parser: argparse.ArgumentParser) -> None:
+    """Scheduler/queue knobs shared by ``serve`` and ``loadtest``."""
+    g = parser.add_argument_group(
+        "serving", "continuous-batching scheduler (raft_stereo_tpu/serve)")
+    g.add_argument("--max_batch", type=int, default=4,
+                   help="max requests stacked through one dispatch")
+    g.add_argument("--queue_depth", type=int, default=64,
+                   help="bounded request-queue depth (admission "
+                        "backpressure past this)")
+    g.add_argument("--window", type=int, default=2,
+                   help="max device dispatches in flight")
+    g.add_argument("--iters", type=int, default=32,
+                   help="refinement iterations per request (the request "
+                        "may override)")
+    g.add_argument("--bucket", type=int, default=0,
+                   help="pad request shapes up to multiples of this to "
+                        "bound compiled buckets (0 = exact /32 padding)")
+    g.add_argument("--linger_ms", type=float, default=0.0,
+                   help="wait up to this long for same-bucket stragglers "
+                        "while a batch is below max_batch")
+    g.add_argument("--no_aot", action="store_true",
+                   help="skip AOT lower().compile(); jit on first call")
+    g.add_argument("--slo_every", type=int, default=16,
+                   help="emit one `slo` rollup event every N retirements")
+
+
+def serve_config(args: argparse.Namespace):
+    from raft_stereo_tpu.serve import ServeConfig
+    return ServeConfig(
+        max_batch=args.max_batch, queue_depth=args.queue_depth,
+        window=args.window, default_iters=args.iters, bucket=args.bucket,
+        linger_s=args.linger_ms / 1e3, aot=not args.no_aot,
+        slo_every=args.slo_every)
+
+
+def _parse_shapes(specs) -> list:
+    """['48x96', ...] -> [(48, 96), ...] (the --shapes/--warm_shapes
+    format)."""
+    out = []
+    for spec in specs:
+        h, w = spec.lower().split("x")
+        out.append((int(h), int(w)))
+    return out
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    """The serving flag surface (``cli serve``): HTTP front + scheduler."""
+    parser = argparse.ArgumentParser(
+        description="RAFT-Stereo TPU serving (continuous batching)")
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="reference .pth or orbax state dir")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8600)
+    parser.add_argument("--run_dir", default=None,
+                        help="write request/queue/slo telemetry under this "
+                             "run directory")
+    parser.add_argument("--warm_shapes", nargs="+", default=[],
+                        help="AOT-precompile these HxW raw shapes before "
+                             "admitting traffic (e.g. 384x512 540x960)")
+    parser.add_argument("--ckpt_dir", default=None,
+                        help="watch this checkpoint dir: SIGHUP hot-reloads "
+                             "the newest manifest-valid checkpoint without "
+                             "dropping queued work")
+    parser.add_argument("--ckpt_name", default="raft-stereo",
+                        help="checkpoint name prefix inside --ckpt_dir")
+    parser.add_argument("--drain_timeout_s", type=float, default=300.0,
+                        help="max seconds to finish admitted work after "
+                             "SIGTERM/SIGINT before giving up (exit 1)")
+    add_serve_args(parser)
+    add_model_args(parser)
+    return parser
+
+
+def build_loadtest_parser() -> argparse.ArgumentParser:
+    """The load-drill flag surface (``cli loadtest``): synthetic
+    many-client trace vs a sequential-predict baseline."""
+    parser = argparse.ArgumentParser(
+        description="RAFT-Stereo TPU serving load test")
+    parser.add_argument("--restore_ckpt", default=None,
+                        help="reference .pth or orbax state dir")
+    parser.add_argument("--run_dir", default="runs/loadtest",
+                        help="telemetry root; the sequential baseline lands "
+                             "in <run_dir>/seq, the served run in "
+                             "<run_dir>/serve (gate: cli compare)")
+    parser.add_argument("--shapes", nargs="+",
+                        default=["48x96", "64x128", "96x64"],
+                        help="raw HxW request shapes (>= 3 distinct buckets "
+                             "for the drill)")
+    parser.add_argument("--clients", type=int, default=8,
+                        help="concurrent client threads")
+    parser.add_argument("--requests_per_client", type=int, default=4)
+    parser.add_argument("--video_streams", type=int, default=1,
+                        help="how many clients are video sessions riding "
+                             "flow_init warm starts")
+    parser.add_argument("--poison_at", type=int, default=None,
+                        help="global request ordinal to corrupt with a NaN "
+                             "pixel (per-request isolation drill)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--no_baseline", action="store_true",
+                        help="skip the sequential-predict baseline phase")
+    parser.add_argument("--no_progress", action="store_true",
+                        help="suppress LOADTEST progress lines")
+    add_serve_args(parser)
+    add_model_args(parser)
+    return parser
+
+
+def _serve_main():
+    """Console entry point (``cli serve``): stdlib HTTP front over the
+    continuous-batching scheduler; SIGTERM/SIGINT drain, SIGHUP reload."""
+    import logging
+    import signal
+
+    args = build_serve_parser().parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
+    from raft_stereo_tpu.serve import StereoServer
+    from raft_stereo_tpu.serve.http import make_http_server, serve_forever
+    from raft_stereo_tpu.training.resilience import SignalGuard
+
+    cfg = model_config(args)
+    _, variables = load_variables(args.restore_ckpt, cfg)
+    tel = None
+    if args.run_dir:
+        from raft_stereo_tpu.obs import Telemetry
+        tel = Telemetry(args.run_dir, stall_deadline_s=None)
+        tel.run_start(config={"mode": "serve", "port": args.port,
+                              "max_batch": args.max_batch,
+                              "window": args.window, "iters": args.iters})
+    server = StereoServer(cfg, variables, serve_config(args), telemetry=tel)
+    if args.warm_shapes:
+        n = server.warmup(_parse_shapes(args.warm_shapes),
+                          batch_sizes=(1, args.max_batch))
+        logging.getLogger(__name__).info("serve: warmed %d executables", n)
+
+    reload_wanted = [False]
+    if args.ckpt_dir and hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP,
+                      lambda *_: reload_wanted.__setitem__(0, True))
+
+    def maybe_reload():
+        if not reload_wanted[0]:
+            return
+        reload_wanted[0] = False
+        from raft_stereo_tpu.training.resilience import find_latest_valid
+        ckpt, _reports = find_latest_valid(args.ckpt_dir, args.ckpt_name)
+        if ckpt is None:
+            raise RuntimeError(
+                f"no manifest-valid checkpoint under {args.ckpt_dir}")
+        _, fresh = load_variables(ckpt, cfg)
+        server.reload(fresh, note=ckpt)
+
+    httpd = make_http_server(server, args.host, args.port)
+    with SignalGuard() as guard:
+        rc = serve_forever(server, httpd,
+                           should_stop=lambda: guard.requested,
+                           maybe_reload=maybe_reload if args.ckpt_dir
+                           else None,
+                           drain_timeout_s=args.drain_timeout_s)
+    if tel is not None:
+        tel.emit("run_end", steps=server.slo.completed, ok=rc == 0)
+        tel.close()
+    raise SystemExit(rc)
+
+
+def _loadtest_main():
+    """Console entry point (``cli loadtest``): drive the synthetic trace,
+    print the accounting summary, exit 1 on any lost admitted request."""
+    import json
+    import logging
+    import os
+    import threading
+
+    args = build_loadtest_parser().parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
+    from raft_stereo_tpu.inference import StereoPredictor
+    from raft_stereo_tpu.obs import Telemetry
+    from raft_stereo_tpu.serve import StereoServer
+    from raft_stereo_tpu.serve.loadtest import (LoadTestConfig, run_baseline,
+                                                run_clients)
+    from raft_stereo_tpu.training.resilience import SignalGuard
+
+    cfg = model_config(args)
+    _, variables = load_variables(args.restore_ckpt, cfg)
+    lt = LoadTestConfig(
+        shapes=_parse_shapes(args.shapes), clients=args.clients,
+        requests_per_client=args.requests_per_client,
+        video_streams=args.video_streams, iters=args.iters,
+        poison_at=args.poison_at, seed=args.seed,
+        progress=not args.no_progress)
+    summary = {"config": {"shapes": args.shapes, "clients": args.clients,
+                          "requests_per_client": args.requests_per_client,
+                          "video_streams": args.video_streams,
+                          "poison_at": args.poison_at,
+                          "max_batch": args.max_batch,
+                          "window": args.window, "iters": args.iters}}
+    if not args.no_baseline:
+        with Telemetry(os.path.join(args.run_dir, "seq"),
+                       stall_deadline_s=None) as tel_seq:
+            tel_seq.run_start(config={"mode": "loadtest-seq"})
+            predictor = StereoPredictor(cfg, variables,
+                                        valid_iters=args.iters,
+                                        bucket=args.bucket)
+            summary["sequential"] = run_baseline(predictor, lt, tel_seq)
+        print(f"LOADTEST baseline {json.dumps(summary['sequential'])}",
+              flush=True)
+    tel = Telemetry(os.path.join(args.run_dir, "serve"),
+                    stall_deadline_s=None)
+    tel.run_start(config={"mode": "loadtest-serve"})
+    server = StereoServer(cfg, variables, serve_config(args), telemetry=tel)
+    # AOT-warm every program the trace can reach — cold buckets at every
+    # batch size plus the video streams' warm flavor — so the timed phase
+    # measures serving, not compilation
+    server.warmup(lt.shapes, batch_sizes=range(1, args.max_batch + 1),
+                  iters=lt.iters)
+    video_shapes = {lt.shapes[c % len(lt.shapes)]
+                    for c in range(lt.video_streams)}
+    if video_shapes:
+        server.warmup(sorted(video_shapes),
+                      batch_sizes=range(
+                          1, min(lt.video_streams, args.max_batch) + 1),
+                      iters=lt.iters, warm=True)
+    with SignalGuard() as guard:
+        # mid-drill SIGTERM -> graceful drain: stop admitting, finish every
+        # admitted request (the load_drill's zero-lost invariant)
+        stop = threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                if guard.requested:
+                    server.request_drain()
+                    return
+                stop.wait(0.05)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        try:
+            summary["served"] = run_clients(server, lt, tel)
+        finally:
+            stop.set()
+            watcher.join(timeout=2.0)
+    server.request_drain()
+    drained = server.join(timeout=600.0)
+    summary["served"]["drained"] = drained
+    summary["served"]["signal"] = guard.signame
+    tel.emit("run_end", steps=server.slo.completed, ok=drained)
+    tel.close()
+    print(f"LOADTEST summary {json.dumps(summary, sort_keys=True)}",
+          flush=True)
+    lost = summary["served"]["lost"]
+    raise SystemExit(0 if drained and lost == 0 else 1)
+
+
 def _train_main():
     """Console entry point (`raft-stereo-train`); same surface as
     train_stereo.py."""
@@ -375,13 +631,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     * ``lint [--graph|--ast]`` — graftlint: jaxpr/HLO contract rules +
       tracer-safety AST lint (raft_stereo_tpu/analysis/; exit 1 on
       unsuppressed error-severity findings),
+    * ``serve`` — continuous-batching HTTP serving with SLO telemetry,
+      graceful drain and SIGHUP hot reload (raft_stereo_tpu/serve),
+    * ``loadtest`` — the synthetic many-client serving drill vs a
+      sequential baseline (exit 1 on any lost admitted request),
     * ``train`` / ``eval`` — the console entry points, for environments
       without the installed scripts.
     """
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    commands = ("telemetry", "compare", "lint", "train", "eval")
+    commands = ("telemetry", "compare", "lint", "train", "eval", "serve",
+                "loadtest")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -396,10 +657,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "lint":
         from raft_stereo_tpu.analysis.runner import main as lint_main
         return lint_main(rest)
-    # _train_main/_eval_main parse sys.argv via argparse; present the
+    # the remaining mains parse sys.argv via argparse; present the
     # remainder as the whole command line
     sys.argv = [f"{sys.argv[0]} {cmd}"] + rest
-    (_train_main if cmd == "train" else _eval_main)()
+    {"train": _train_main, "eval": _eval_main,
+     "serve": _serve_main, "loadtest": _loadtest_main}[cmd]()
     return 0
 
 

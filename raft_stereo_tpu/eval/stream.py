@@ -44,6 +44,8 @@ from typing import Any, Callable, Dict, Optional, Union
 
 import numpy as np
 
+from raft_stereo_tpu.serve.batching import collect_group, stack_pairs
+
 logger = logging.getLogger(__name__)
 
 # pipeline-gauge cadence, matching data/loader.py's producer gauges
@@ -223,19 +225,27 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
             if frames_left and len(in_flight) < window:
                 idx0, s0, wait = take_decoded()
                 fill()
-                group = [(idx0, s0)]
                 # stack consecutive same-shape frames into one dispatch;
                 # a shape break is pushed back and starts the next group
-                while len(group) < microbatch and (decoded or pending):
+                # (serve/batching.py owns the policy, shared with the
+                # serving scheduler). The decode wait of a pushed-back
+                # frame is still charged to the CURRENT group — it was
+                # paid while forming it.
+                waits = [wait]
+
+                def pull():
+                    if not (decoded or pending):
+                        return None
                     idx_k, s_k, wait_k = take_decoded()
                     fill()
-                    wait += wait_k
-                    if s_k["image1"].shape != s0["image1"].shape:
-                        decoded.appendleft((idx_k, s_k))
-                        break
-                    group.append((idx_k, s_k))
-                im1 = np.stack([s["image1"] for _, s in group])
-                im2 = np.stack([s["image2"] for _, s in group])
+                    waits.append(wait_k)
+                    return (idx_k, s_k)
+
+                group = collect_group(
+                    (idx0, s0), pull, decoded.appendleft, microbatch,
+                    key=lambda item: item[1]["image1"].shape)
+                wait = sum(waits)
+                im1, im2 = stack_pairs([s for _, s in group])
                 t0 = time.perf_counter()
                 handle = predictor.predict_async(im1, im2, iters)
                 dispatch_s = time.perf_counter() - t0

@@ -48,6 +48,7 @@ class PendingPrediction:
         self._flow = flow_dev
         self._unpad = unpad
         self._result: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
         #: host seconds spent inside the dispatching call (async enqueue,
         #: not device time)
         self.dispatch_s = dispatch_s
@@ -66,12 +67,31 @@ class PendingPrediction:
         except Exception:
             return False
 
+    def exception(self) -> Optional[BaseException]:
+        """The deferred device/fetch error this handle captured, if any
+        (without re-raising). None while unfetched or on success."""
+        return self._error
+
     def result(self) -> np.ndarray:
         """Block until the dispatch completes; unpadded ``(B, H, W, 1)``
-        flow-x as numpy. Idempotent — later calls return the cached fetch."""
+        flow-x as numpy. Idempotent — later calls return the cached fetch.
+
+        Because dispatch is asynchronous, a device-side execution error
+        surfaces HERE, not at ``predict_async`` — it is captured once and
+        re-raised on this and every later call (with the buffer released),
+        so one poisoned frame fails as a per-request error the caller can
+        catch instead of leaving the handle half-fetched."""
+        if self._error is not None:
+            raise self._error
         if self._result is None:
             t0 = time.perf_counter()
-            self._result = np.asarray(self._unpad(self._flow))
+            try:
+                self._result = np.asarray(self._unpad(self._flow))
+            except Exception as exc:
+                self._error = exc
+                self._flow = None
+                self.fetch_s = time.perf_counter() - t0
+                raise
             self.fetch_s = time.perf_counter() - t0
             self._flow = None  # release the device buffer reference
         return self._result

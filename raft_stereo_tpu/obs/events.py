@@ -27,17 +27,18 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # Back-compat: every schema version whose artifacts are still readable.
 # v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
 # op_counts jaxpr profile event), v3 -> v4 (the graftlint `lint` report
-# event) and v4 -> v5 (the fault-tolerance events: preempt/resume/
-# ckpt_integrity/anomaly) were purely ADDITIVE — no earlier event changed
-# its required fields — so pre-existing runs/*/events.jsonl lint clean: an
-# older record is validated against its own surface (it just may not use
-# events introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
+# event), v4 -> v5 (the fault-tolerance events: preempt/resume/
+# ckpt_integrity/anomaly) and v5 -> v6 (the serving events: request/queue/
+# slo) were purely ADDITIVE — no earlier event changed its required
+# fields — so pre-existing runs/*/events.jsonl lint clean: an older record
+# is validated against its own surface (it just may not use events
+# introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -50,6 +51,9 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "resume": 5,
     "ckpt_integrity": 5,
     "anomaly": 5,
+    "request": 6,
+    "queue": 6,
+    "slo": 6,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -110,6 +114,18 @@ EVENT_TYPES: Dict[str, tuple] = {
     "resume": ("step", "path"),
     "ckpt_integrity": ("path", "ok"),
     "anomaly": ("kind",),
+    # Serving (raft_stereo_tpu/serve, schema v6). `request`: one terminal
+    # record per served request — `status` is "ok" or "error"; latency,
+    # queue wait, bucket/batch and (on failure) the captured error +
+    # traceback tail ride along (per-request fault isolation's paper
+    # trail). `queue`: admission-side gauge — request-queue `depth`, with
+    # in-flight dispatches and admitted/completed/failed/rejected
+    # counters as extras. `slo`: the rolling headline every N
+    # retirements — p50/p99 end-to-end latency (ms), sustained
+    # `pairs_per_sec` over the sample window, and `in_flight` depth.
+    "request": ("id", "status"),
+    "queue": ("depth",),
+    "slo": ("p50_ms", "p99_ms", "pairs_per_sec", "in_flight"),
     "run_end": ("steps",),
 }
 

@@ -1,0 +1,36 @@
+"""Continuous-batching stereo serving (ROADMAP item 3).
+
+The streaming evaluator (eval/stream.py) proved the primitives — async
+dispatch handles, a bounded in-flight window, consecutive same-shape
+micro-batching — against a *dataset*. This package points the same
+machinery at *concurrent clients*:
+
+* :mod:`serve.batching` — the one copy of the greedy same-key grouping
+  policy, shared with the streaming evaluator (which imports it back);
+* :mod:`serve.cache` — shape-bucketed AOT ``lower().compile()`` executable
+  cache with per-entry ``xla_memory``/``xla_cost`` introspection and
+  in-place hot reload of model variables;
+* :mod:`serve.server` — the bounded request queue + scheduler thread:
+  continuous micro-batches across client streams, per-request fault
+  isolation (a poisoned request fails alone; its batchmates retire
+  normally), graceful drain, per-stream ``flow_init`` warm starts for
+  video sessions;
+* :mod:`serve.slo` — p50/p99 latency, in-flight depth and sustained
+  pairs/s as schema-v6 ``request``/``queue``/``slo`` events;
+* :mod:`serve.http` — a stdlib-only HTTP front (``cli serve``);
+* :mod:`serve.loadtest` — the synthetic many-client trace driver
+  (``cli loadtest``; proof harness: scripts/load_drill.py).
+"""
+
+from raft_stereo_tpu.serve.batching import (BoundedQueue, QueueClosed,
+                                            collect_group, stack_pairs)
+from raft_stereo_tpu.serve.server import (ServeConfig, ServeResult,
+                                          ServerDraining, StereoServer)
+from raft_stereo_tpu.serve.cache import BucketKey, ExecutableCache
+from raft_stereo_tpu.serve.slo import SLOTracker
+
+__all__ = [
+    "BoundedQueue", "QueueClosed", "collect_group", "stack_pairs",
+    "ServeConfig", "ServeResult", "ServerDraining", "StereoServer",
+    "BucketKey", "ExecutableCache", "SLOTracker",
+]

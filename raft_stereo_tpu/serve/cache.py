@@ -1,0 +1,199 @@
+"""Shape-bucketed compiled-executable cache for the serving scheduler.
+
+One cache entry = one AOT-compiled inference program for a padded bucket
+shape ``(H, W)`` × batch size × refinement iteration count × warm-start
+flavor. AOT ``lower().compile()`` (the obs/xla.py pattern every other
+compile site uses) instead of first-call jit so that:
+
+* warmup is explicit — ``cli serve`` pre-compiles the configured buckets
+  before admitting traffic, so no client pays a compile inside its
+  latency budget;
+* every entry's memory/cost analysis is emitted as ``xla_memory``/
+  ``xla_cost`` events at compile time (``source="serve:<key>"``), making
+  the cache's footprint a first-class observable.
+
+The served program is the model's ``test_mode`` forward plus the
+device-side per-request guard: a ``(B,)`` finiteness flag vector over each
+sample's output — PR 7's anomaly-guard idea re-targeted from "skip the
+optimizer update" to "fail exactly the poisoned request". The low-res flow
+also comes back, feeding per-stream ``flow_init`` warm starts (RAFT's own
+temporal warm start; the warm flavor adds the ``flow_init`` input).
+
+Hot reload swaps the variables the executables are invoked with — entries
+are keyed on shapes/dtypes only, and a reload with an identical pytree
+structure (enforced via the resilience tree hash) never recompiles.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.models import create_model
+
+logger = logging.getLogger(__name__)
+
+
+class BucketKey(NamedTuple):
+    """Identity of one compiled serving program."""
+
+    height: int   # padded (bucket) height
+    width: int    # padded (bucket) width
+    batch: int
+    iters: int
+    warm: bool    # True = the flavor with a flow_init input
+
+    def label(self) -> str:
+        return (f"{self.height}x{self.width}b{self.batch}i{self.iters}"
+                f"{'w' if self.warm else ''}")
+
+
+class ExecutableCache:
+    """(bucket H×W, batch, iters, warm) -> compiled test-mode forward.
+
+    ``telemetry`` receives one ``xla_memory``/``xla_cost`` pair per entry
+    (fail-open: an introspection error never blocks serving). ``aot=False``
+    falls back to plain ``jax.jit`` (first call compiles) — the escape
+    hatch for backends where ShapeDtypeStruct lowering misbehaves.
+    """
+
+    def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
+                 telemetry=None, aot: bool = True):
+        self.cfg = cfg
+        self.model = create_model(cfg)
+        self.telemetry = telemetry
+        self.aot = aot
+        self._lock = threading.Lock()
+        self._entries: Dict[BucketKey, Any] = {}
+        self._variables = variables
+        self._tree_hash = self._hash(variables)
+
+    @staticmethod
+    def _hash(variables: Dict) -> str:
+        from raft_stereo_tpu.training.resilience import tree_structure_hash
+        return tree_structure_hash(variables)
+
+    @property
+    def variables(self) -> Dict:
+        with self._lock:
+            return self._variables
+
+    def reload(self, variables: Dict) -> None:
+        """Swap the served variables in place (hot model reload).
+
+        The pytree structure (leaf shapes/dtypes) must match what the
+        entries were compiled against — a mismatch would need new
+        executables and is a config change, not a reload."""
+        new_hash = self._hash(variables)
+        if new_hash != self._tree_hash:
+            raise ValueError(
+                f"reload variables have pytree hash {new_hash}, executables "
+                f"were compiled against {self._tree_hash} — a structural "
+                "change requires a new server, not a hot reload")
+        with self._lock:
+            self._variables = variables
+
+    # --- compilation ---------------------------------------------------------
+
+    def _build(self, key: BucketKey):
+        model, iters = self.model, key.iters
+
+        if key.warm:
+            def run(variables, im1, im2, flow_init):
+                flow_lr, flow_up = model.apply(
+                    variables, im1, im2, iters=iters, flow_init=flow_init,
+                    test_mode=True)
+                finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
+                return flow_lr, flow_up, finite
+        else:
+            def run(variables, im1, im2):
+                flow_lr, flow_up = model.apply(
+                    variables, im1, im2, iters=iters, test_mode=True)
+                finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
+                return flow_lr, flow_up, finite
+
+        jitted = jax.jit(run)
+        if not self.aot:
+            return jitted
+        def leaf_spec(leaf):
+            # metadata only — np.shape/result_type never touch leaf data
+            dtype = getattr(leaf, "dtype", None)
+            if dtype is None:
+                dtype = np.result_type(leaf)
+            return jax.ShapeDtypeStruct(np.shape(leaf), dtype)
+
+        img = jax.ShapeDtypeStruct(
+            (key.batch, key.height, key.width, 3), jnp.float32)
+        specs = [jax.tree.map(leaf_spec, self.variables), img, img]
+        if key.warm:
+            factor = 2 ** self.cfg.n_downsample
+            specs.append(jax.ShapeDtypeStruct(
+                (key.batch, key.height // factor, key.width // factor, 2),
+                jnp.float32))
+        try:
+            compiled = jitted.lower(*specs).compile()
+        except Exception:
+            logger.exception("AOT compile failed for %s; falling back to "
+                             "jit-on-first-call", key.label())
+            return jitted
+        try:
+            from raft_stereo_tpu.obs.xla import introspect_compiled
+            introspect_compiled(compiled, telemetry=self.telemetry,
+                                source=f"serve:{key.label()}",
+                                extra={"bucket": list(key[:2]),
+                                       "batch": key.batch,
+                                       "iters": key.iters,
+                                       "warm": key.warm})
+        except Exception:
+            logger.exception("executable introspection failed for %s "
+                             "(serving continues)", key.label())
+        return compiled
+
+    def get(self, key: BucketKey):
+        """The compiled program for ``key`` (compiling on miss)."""
+        with self._lock:
+            fn = self._entries.get(key)
+        if fn is None:
+            fn = self._build(key)
+            with self._lock:
+                fn = self._entries.setdefault(key, fn)
+        return fn
+
+    def warmup(self, keys) -> int:
+        """Pre-compile every key; returns the number of NEW entries."""
+        fresh = 0
+        for key in keys:
+            with self._lock:
+                have = key in self._entries
+            if not have:
+                self.get(key)
+                fresh += 1
+        return fresh
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> Tuple[BucketKey, ...]:
+        with self._lock:
+            return tuple(self._entries)
+
+    # --- invocation ----------------------------------------------------------
+
+    def __call__(self, key: BucketKey, im1, im2,
+                 flow_init: Optional[np.ndarray] = None):
+        """Run the key's program with the CURRENT variables; returns
+        ``(flow_lowres, flow_up, finite_flags)`` device arrays."""
+        fn = self.get(key)
+        variables = self.variables
+        if key.warm:
+            if flow_init is None:
+                raise ValueError("warm bucket requires a flow_init batch")
+            return fn(variables, im1, im2, flow_init)
+        return fn(variables, im1, im2)

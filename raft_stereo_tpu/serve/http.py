@@ -1,0 +1,163 @@
+"""Stdlib-only HTTP front for :class:`StereoServer` (``cli serve``).
+
+No web framework (the container policy: nothing beyond the baked-in
+toolchain), so this is ``http.server.ThreadingHTTPServer`` — one thread
+per connection, each blocking on its request's :class:`ResultHandle`
+while the scheduler batches across connections. Endpoints:
+
+* ``POST /v1/predict`` — body is an ``.npz`` with ``left``/``right``
+  HWC arrays; optional query args ``iters``, ``stream``, ``warm=1``.
+  200 → ``.npz`` with ``flow`` (H, W, 1) + request metadata headers;
+  422 → the request retired as an error (poisoned input, etc.);
+  503 → draining or queue-full backpressure. Per-request isolation means
+  one client's 422 never affects another's 200.
+* ``GET /healthz`` — scheduler liveness + counters (JSON); 503 once
+  draining, so load balancers stop routing here during shutdown.
+* ``GET /slo`` — the SLOTracker rollup (p50/p99/pairs_per_sec) as JSON.
+
+SIGTERM/SIGINT → graceful drain via training/resilience.SignalGuard:
+stop admitting, finish every admitted request, exit 0. SIGHUP → hot
+model reload from the newest manifest-verified checkpoint (PR 7's
+verify-before-restore, re-targeted at a live server).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from raft_stereo_tpu.serve.server import (ServerBusy, ServerDraining,
+                                          StereoServer)
+
+logger = logging.getLogger(__name__)
+
+
+def _json_bytes(payload) -> bytes:
+    return (json.dumps(payload, sort_keys=True) + "\n").encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "raft-stereo-serve/1.0"
+    #: set by make_http_server
+    stereo: StereoServer = None  # type: ignore[assignment]
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        logger.debug("http: " + fmt, *args)
+
+    def _reply(self, code: int, body: bytes, ctype: str = "application/json",
+               headers: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            stats = self.stereo.stats()
+            code = 503 if stats["draining"] or stats["stopped"] else 200
+            self._reply(code, _json_bytes(stats))
+        elif path == "/slo":
+            self._reply(200, _json_bytes(self.stereo.stats()))
+        else:
+            self._reply(404, _json_bytes({"error": "not found"}))
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        if url.path != "/v1/predict":
+            self._reply(404, _json_bytes({"error": "not found"}))
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            with np.load(io.BytesIO(self.rfile.read(n))) as npz:
+                left, right = npz["left"], npz["right"]
+        except Exception as exc:
+            self._reply(400, _json_bytes(
+                {"error": f"bad request body: {exc}"}))
+            return
+        q = parse_qs(url.query)
+        try:
+            handle = self.stereo.submit(
+                left, right,
+                iters=int(q["iters"][0]) if "iters" in q else None,
+                stream=q["stream"][0] if "stream" in q else None,
+                warm_start=q.get("warm", ["0"])[0] == "1",
+                timeout=5.0)
+        except ServerDraining:
+            self._reply(503, _json_bytes({"error": "draining"}),
+                        headers={"Retry-After": "never"})
+            return
+        except ServerBusy:
+            self._reply(503, _json_bytes({"error": "queue full"}),
+                        headers={"Retry-After": "1"})
+            return
+        except ValueError as exc:
+            self._reply(400, _json_bytes({"error": str(exc)}))
+            return
+        result = handle.result()
+        meta = {"X-Request-Id": result.request_id,
+                "X-Latency-Ms": round(result.latency_s * 1e3, 3),
+                "X-Batch-Size": result.batch_size,
+                "X-Bucket": result.bucket}
+        if not result.ok:
+            self._reply(422, _json_bytes(
+                {"error": result.error, "kind": result.error_kind,
+                 "request_id": result.request_id}), headers=meta)
+            return
+        buf = io.BytesIO()
+        np.savez_compressed(buf, flow=result.flow)
+        self._reply(200, buf.getvalue(),
+                    ctype="application/octet-stream", headers=meta)
+
+
+def make_http_server(stereo: StereoServer, host: str = "127.0.0.1",
+                     port: int = 8600) -> ThreadingHTTPServer:
+    """Bind (but do not serve) the HTTP front; caller owns serve/shutdown."""
+    handler = type("BoundHandler", (_Handler,), {"stereo": stereo})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def serve_forever(stereo: StereoServer, httpd: ThreadingHTTPServer,
+                  should_stop, poll_s: float = 0.25,
+                  maybe_reload=None, drain_timeout_s: float = 300.0) -> int:
+    """Run the HTTP loop until ``should_stop()`` (typically a
+    SignalGuard's ``requested``), then drain gracefully.
+
+    ``maybe_reload`` (optional) is polled each tick — the SIGHUP hot-reload
+    hook; its exceptions are logged, never fatal (a bad reload must not
+    take down a serving process). Returns the exit code (0 = clean drain).
+    """
+    import time
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="serve-http")
+    t.start()
+    logger.info("serve: listening on http://%s:%d", *httpd.server_address)
+    clean = True
+    try:
+        while not should_stop():
+            time.sleep(poll_s)
+            if maybe_reload is not None:
+                try:
+                    maybe_reload()
+                except Exception:
+                    logger.exception("serve: hot reload failed; continuing "
+                                     "with current weights")
+    finally:
+        logger.info("serve: stop requested — draining")
+        httpd.shutdown()
+        stereo.request_drain()
+        clean = stereo.join(timeout=drain_timeout_s)
+        logger.info("serve: drain %s", "complete" if clean else "TIMED OUT")
+    return 0 if clean else 1

@@ -1,0 +1,195 @@
+"""Synthetic many-client load driver for the serving scheduler.
+
+``cli loadtest`` (and the proof harness scripts/load_drill.py) run this:
+N concurrent client threads — each a stream of same-shape requests, at
+least one a *video* session riding ``flow_init`` warm starts — submit a
+mixed-shape trace against one :class:`StereoServer`, after a
+sequential-``predict()`` baseline over the identical trace. Both phases
+write telemetry run dirs (``step`` + ``throughput`` events), so the
+existing ``cli compare`` gate arbitrates served-vs-sequential throughput
+with the same thresholds every other perf claim in this repo uses.
+
+The driver is also the fault-injection rig: ``poison_at=k`` corrupts the
+k-th request (global ordinal) with a NaN pixel — the per-request isolation
+proof — and a mid-run SIGTERM (scripts/load_drill.py sends one) must drain
+with ZERO lost admitted requests: every client tallies each submit as
+exactly one of ok / failed / rejected, and ``lost`` counts admitted
+requests that never produced a result.
+
+Progress lines (``LOADTEST progress ...``) go to stdout unbuffered so a
+supervising process can time its signals against real completions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu.serve.server import (ServerBusy, ServerDraining,
+                                          StereoServer)
+
+#: default mixed-shape trace: three distinct /32 buckets
+DEFAULT_SHAPES: Tuple[Tuple[int, int], ...] = ((48, 96), (64, 128), (96, 64))
+
+
+@dataclasses.dataclass
+class LoadTestConfig:
+    """Trace shape/fault knobs (CLI: ``cli loadtest``)."""
+
+    shapes: Sequence[Tuple[int, int]] = DEFAULT_SHAPES
+    #: concurrent client threads (>= video_streams)
+    clients: int = 8
+    #: requests per client (a video client's frame count)
+    requests_per_client: int = 4
+    #: how many clients are video sessions (flow_init warm starts)
+    video_streams: int = 1
+    iters: int = 2
+    #: global request ordinal to poison with a NaN pixel (None = off)
+    poison_at: Optional[int] = None
+    seed: int = 0
+    submit_timeout_s: float = 30.0
+    result_timeout_s: float = 600.0
+    #: print LOADTEST progress lines to stdout
+    progress: bool = True
+
+    def trace(self) -> List[List[Dict]]:
+        """Per-client request specs (shape, warm flags, poison marker)."""
+        per_client = []
+        for c in range(self.clients):
+            video = c < self.video_streams
+            # video sessions keep one shape; batch clients cycle so every
+            # bucket sees traffic from several clients
+            shape = self.shapes[c % len(self.shapes)]
+            reqs = []
+            for j in range(self.requests_per_client):
+                ordinal = c * self.requests_per_client + j
+                reqs.append({
+                    "shape": shape, "ordinal": ordinal, "video": video,
+                    "stream": f"video{c}" if video else None,
+                    "poison": ordinal == self.poison_at,
+                })
+            per_client.append(reqs)
+        return per_client
+
+
+def synth_pair(rng: np.random.Generator, h: int, w: int,
+               poison: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    left = rng.integers(0, 255, (h, w, 3)).astype(np.float32)
+    right = rng.integers(0, 255, (h, w, 3)).astype(np.float32)
+    if poison:
+        left[0, 0, 0] = np.nan
+    return left, right
+
+
+def run_baseline(predictor, lt: LoadTestConfig, telemetry=None) -> Dict:
+    """Sequential ``predict()`` over the flattened trace — the throughput
+    floor the served run must meet (clean inputs: the baseline's job is
+    speed, the drill injects its faults only at the server)."""
+    rng = np.random.default_rng(lt.seed)
+    flat = [spec for client in lt.trace() for spec in client]
+    t0 = time.perf_counter()
+    for i, spec in enumerate(flat):
+        left, right = synth_pair(rng, *spec["shape"])
+        td = time.perf_counter()
+        flow = predictor(left[None], right[None], lt.iters)
+        dt = time.perf_counter() - td
+        assert flow.shape[1:3] == spec["shape"]
+        if telemetry is not None:
+            telemetry.step(i, data_wait_s=0.0, dispatch_s=dt, fetch_s=0.0,
+                           batch_size=1)
+    wall = time.perf_counter() - t0
+    pps = len(flat) / wall if wall > 0 else 0.0
+    if telemetry is not None:
+        telemetry.throughput(pps, steps=len(flat), phase="sequential")
+    return {"requests": len(flat), "wall_s": round(wall, 3),
+            "pairs_per_sec": round(pps, 4)}
+
+
+def run_clients(server: StereoServer, lt: LoadTestConfig,
+                telemetry=None) -> Dict:
+    """Drive the trace through ``server`` with ``lt.clients`` threads;
+    returns the accounting summary (ok/failed/rejected/lost per total)."""
+    lock = threading.Lock()
+    tally = {"submitted": 0, "ok": 0, "failed": 0, "rejected": 0,
+             "lost": 0, "poisoned_failed": 0}
+    done_count = [0]
+
+    def progress(note: str) -> None:
+        if lt.progress:
+            with lock:
+                line = (f"LOADTEST progress done={done_count[0]} "
+                        f"ok={tally['ok']} failed={tally['failed']} "
+                        f"rejected={tally['rejected']} {note}")
+            print(line, flush=True)
+
+    def client(idx: int, specs: List[Dict]) -> None:
+        rng = np.random.default_rng(lt.seed + 1000 + idx)
+        for spec in specs:
+            left, right = synth_pair(rng, *spec["shape"],
+                                     poison=spec["poison"])
+            with lock:
+                tally["submitted"] += 1
+            try:
+                handle = server.submit(
+                    left, right, iters=lt.iters, stream=spec["stream"],
+                    warm_start=spec["video"],
+                    timeout=lt.submit_timeout_s)
+            except ServerDraining:
+                with lock:
+                    tally["rejected"] += 1
+                progress(f"client{idx} draining")
+                break  # admission closed: the rest of this client's trace
+            except ServerBusy:
+                with lock:
+                    tally["rejected"] += 1
+                progress(f"client{idx} busy")
+                continue
+            try:
+                result = handle.result(timeout=lt.result_timeout_s)
+            except TimeoutError:
+                with lock:
+                    tally["lost"] += 1  # admitted but never retired
+                progress(f"client{idx} LOST {handle.request_id}")
+                continue
+            with lock:
+                done_count[0] += 1
+                if result.ok:
+                    tally["ok"] += 1
+                else:
+                    tally["failed"] += 1
+                    if spec["poison"]:
+                        tally["poisoned_failed"] += 1
+            if telemetry is not None and result.ok:
+                # data_wait stays 0.0 so the seq-vs-serve phase columns
+                # compare device time to device time; admission queueing
+                # is its own field (and the slo rollup's p50/p99 covers
+                # the end-to-end story)
+                telemetry.step(
+                    spec["ordinal"], data_wait_s=0.0,
+                    dispatch_s=result.latency_s - result.queue_wait_s,
+                    fetch_s=0.0, batch_size=1, bucket=result.bucket,
+                    queue_wait_s=result.queue_wait_s,
+                    served_batch=result.batch_size)
+            progress(f"client{idx} {result.request_id} "
+                     f"{'ok' if result.ok else 'FAILED'} b={result.batch_size}")
+
+    threads = [threading.Thread(target=client, args=(i, specs),
+                                name=f"load-client{i}", daemon=True)
+               for i, specs in enumerate(lt.trace())]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    served = tally["ok"] + tally["failed"]
+    pps = served / wall if wall > 0 else 0.0
+    if telemetry is not None and served:
+        telemetry.throughput(pps, steps=served, phase="served")
+    tally.update(wall_s=round(wall, 3), pairs_per_sec=round(pps, 4),
+                 slo=server.slo.snapshot())
+    return tally

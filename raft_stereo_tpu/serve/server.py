@@ -1,0 +1,476 @@
+"""The continuous-batching stereo server.
+
+Architecture (one scheduler thread, the production shape of
+eval/stream.py's ``_run_streaming``):
+
+::
+
+    clients --submit()--> BoundedQueue --scheduler--> ExecutableCache
+                                          |  (greedy same-bucket groups,
+                                          |   bounded in-flight window)
+    clients <--ResultHandle-- retire <----+
+
+* **Admission** — ``submit()`` copies nothing onto the device; it enqueues
+  a request into a bounded queue (backpressure instead of backlog) and
+  returns a :class:`ResultHandle` future. After ``request_drain()`` the
+  queue is closed: new submits raise :class:`ServerDraining`, everything
+  already admitted still completes — that is the SIGTERM contract
+  (PR 7's SignalGuard semantics, re-targeted from "save and exit" to
+  "stop admitting, finish in-flight, exit 0").
+* **Batching** — the scheduler pulls the queue in arrival order and packs
+  consecutive requests with the same ``(bucket H×W, iters, warm)`` key
+  into one dispatch (serve/batching.py — the same greedy policy the
+  streaming evaluator uses), optionally lingering ``linger_s`` for
+  stragglers while the batch is short. Requests with different RAW shapes
+  batch together whenever they pad to the same bucket; each carries its
+  own padder for exact unpadding.
+* **Fault isolation** — the compiled program returns a per-sample
+  finiteness flag computed ON DEVICE next to the outputs. A poisoned
+  request (NaN/Inf anywhere in its output) retires as an error result;
+  its batchmates retire normally — one bad client cannot kill a batch,
+  let alone the scheduler. A dispatch-level exception fails exactly the
+  requests of that batch (captured traceback on each), and the scheduler
+  keeps serving.
+* **Warm starts** — ``stream_id + warm_start=True`` requests ride the
+  warm program flavor: the server keeps each video session's last low-res
+  flow and feeds it back as ``flow_init`` (zeros on the first frame).
+  Sessions are keyed per stream and reset whenever the stream changes
+  shape. Frames of one session must be submitted in order (await each
+  result before the next submit — the loadtest's video client does).
+* **Hot reload** — ``reload(variables)`` swaps the model weights between
+  batches (ExecutableCache.reload): queued and in-flight work is never
+  dropped; requests dispatched after the swap use the new weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+import traceback as tb_module
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.inference import PAD_DIVIS, bucket_size
+from raft_stereo_tpu.ops.geometry import InputPadder
+from raft_stereo_tpu.serve.batching import (BoundedQueue, QueueClosed,
+                                            collect_group)
+from raft_stereo_tpu.serve.cache import BucketKey, ExecutableCache
+from raft_stereo_tpu.serve.slo import SLOTracker
+
+logger = logging.getLogger(__name__)
+
+
+class ServerDraining(Exception):
+    """submit() after request_drain(): admission is closed for shutdown."""
+
+
+class ServerBusy(Exception):
+    """submit() timed out on a full queue: backpressure, try again."""
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Scheduler/queue knobs (CLI: ``cli serve`` / ``cli loadtest``)."""
+
+    #: max requests stacked through one dispatch
+    max_batch: int = 4
+    #: bounded request-queue depth (admission backpressure past this)
+    queue_depth: int = 64
+    #: max dispatches in flight (the eval/stream window)
+    window: int = 2
+    #: refinement iterations when a request does not specify its own
+    default_iters: int = 32
+    #: pad buckets up to multiples of this (0 = exact /32 padding);
+    #: inference.bucket_size semantics
+    bucket: int = 0
+    #: wait up to this long for same-bucket stragglers while a batch is
+    #: below max_batch (0 = dispatch immediately)
+    linger_s: float = 0.0
+    #: AOT-compile bucket programs (False: jit on first call)
+    aot: bool = True
+    #: emit one `slo` rollup every N retired requests
+    slo_every: int = 16
+    #: latency sliding-window size for p50/p99 / sustained pairs/s
+    slo_window: int = 256
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """Terminal outcome of one request (what :meth:`ResultHandle.result`
+    returns — errors are DATA here, not exceptions: the per-request
+    isolation contract)."""
+
+    request_id: str
+    ok: bool
+    flow: Optional[np.ndarray] = None    # unpadded (H, W, 1) flow-x
+    error: Optional[str] = None
+    error_kind: Optional[str] = None     # "nonfinite_output" | "dispatch"
+    traceback: Optional[str] = None
+    stream: Optional[str] = None
+    latency_s: float = 0.0
+    queue_wait_s: float = 0.0
+    batch_size: int = 0
+    bucket: str = ""
+
+    @property
+    def disparity(self) -> Optional[np.ndarray]:
+        """Positive disparity (H, W) — the library-API convention."""
+        return None if self.flow is None else -self.flow[..., 0]
+
+
+class ResultHandle:
+    """Future for one admitted request; ``result()`` blocks until retired."""
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._done = threading.Event()
+        self._result: Optional[ServeResult] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not retired within {timeout}s")
+        assert self._result is not None
+        return self._result
+
+    def _set(self, result: ServeResult) -> None:
+        self._result = result
+        self._done.set()
+
+
+@dataclasses.dataclass
+class _Request:
+    id: str
+    image1: np.ndarray
+    image2: np.ndarray
+    iters: int
+    warm: bool
+    stream: Optional[str]
+    t_submit: float
+    handle: ResultHandle
+    t_dispatch: float = 0.0
+
+
+class StereoServer:
+    """Continuous-batching inference server over one model + one device
+    program cache. Thread-safe ``submit``; one scheduler thread."""
+
+    def __init__(self, cfg: RAFTStereoConfig, variables: Dict,
+                 serve: Optional[ServeConfig] = None, *, telemetry=None,
+                 autostart: bool = True):
+        self.cfg = cfg
+        self.serve = serve or ServeConfig()
+        self.telemetry = telemetry
+        self.cache = ExecutableCache(cfg, variables, telemetry=telemetry,
+                                     aot=self.serve.aot)
+        self.slo = SLOTracker(telemetry, window=self.serve.slo_window,
+                              emit_every=self.serve.slo_every)
+        self._queue: BoundedQueue = BoundedQueue(self.serve.queue_depth)
+        self._in_flight: "deque" = deque()
+        self._sessions: Dict[str, Tuple[Tuple[int, ...], np.ndarray]] = {}
+        self._pending_vars: Optional[Dict] = None
+        self._reload_note: Optional[str] = None
+        self._vars_lock = threading.Lock()
+        self._ids = itertools.count()
+        self._draining = False
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-scheduler")
+        if autostart:
+            self.start()
+
+    # --- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "StereoServer":
+        if not self._thread.is_alive() and not self._stopped.is_set():
+            self._thread.start()
+        return self
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def request_drain(self) -> None:
+        """Graceful shutdown, phase 1: close admission. Already-admitted
+        requests (queued or in flight) all still complete."""
+        if not self._draining:
+            self._draining = True
+            logger.info("serve: drain requested — admission closed, "
+                        "finishing %d queued + %d in-flight dispatches",
+                        len(self._queue), len(self._in_flight))
+        self._queue.close()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the scheduler to finish draining; True when stopped."""
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        self.request_drain()
+        if not self._thread.is_alive() and not self._stopped.is_set():
+            # never started: drain the queue inline so admitted work is
+            # still honored (the autostart=False test path)
+            self._run()
+            return True
+        return self.join(timeout)
+
+    # --- admission -----------------------------------------------------------
+
+    def submit(self, left: np.ndarray, right: np.ndarray, *,
+               iters: Optional[int] = None, stream: Optional[str] = None,
+               warm_start: bool = False,
+               timeout: Optional[float] = None) -> ResultHandle:
+        """Admit one HWC stereo pair; returns the request's future.
+
+        Raises :class:`ServerDraining` once a drain started and
+        :class:`ServerBusy` when the bounded queue stays full past
+        ``timeout`` — both BEFORE admission: a raised submit is a rejected
+        request, never a lost one."""
+        if self._draining:
+            self.slo.reject()
+            raise ServerDraining("server is draining; submit rejected")
+        left = np.asarray(left)
+        right = np.asarray(right)
+        if left.ndim != 3 or right.ndim != 3 or left.shape != right.shape:
+            raise ValueError(
+                f"expected matching HWC pairs, got {left.shape} vs "
+                f"{right.shape}")
+        req = _Request(
+            id=f"r{next(self._ids):06d}",
+            image1=left, image2=right,
+            iters=int(iters) if iters is not None
+            else self.serve.default_iters,
+            warm=bool(warm_start and stream is not None),
+            stream=stream, t_submit=time.perf_counter(),
+            handle=ResultHandle(f"r?"))
+        req.handle.request_id = req.id
+        try:
+            admitted = self._queue.put(req, timeout=timeout)
+        except QueueClosed:
+            self.slo.reject()
+            raise ServerDraining("server is draining; submit rejected")
+        if not admitted:
+            self.slo.reject()
+            raise ServerBusy(
+                f"request queue full ({self.serve.queue_depth}) for "
+                f"{timeout}s")
+        self.slo.admit(queue_depth=len(self._queue),
+                       in_flight=len(self._in_flight))
+        return req.handle
+
+    # --- hot reload ----------------------------------------------------------
+
+    def reload(self, variables: Dict, note: Optional[str] = None) -> None:
+        """Swap model weights at the next batch boundary. Queued and
+        in-flight requests are untouched; later dispatches use the new
+        weights. Raises (synchronously) on a pytree-structure mismatch."""
+        # validate the structure NOW so a bad reload fails the caller, not
+        # the scheduler thread mid-traffic
+        probe_hash = self.cache._hash(variables)
+        if probe_hash != self.cache._tree_hash:
+            raise ValueError(
+                "reload variables do not match the served pytree structure")
+        with self._vars_lock:
+            self._pending_vars = variables
+            self._reload_note = note
+
+    def _apply_pending_reload(self) -> None:
+        with self._vars_lock:
+            variables, note = self._pending_vars, self._reload_note
+            self._pending_vars = None
+            self._reload_note = None
+        if variables is None:
+            return
+        self.cache.reload(variables)
+        logger.info("serve: hot-reloaded model variables%s",
+                    f" ({note})" if note else "")
+        if self.telemetry is not None:
+            self.telemetry.emit("queue", depth=len(self._queue),
+                                in_flight=len(self._in_flight),
+                                reload=True, note=note,
+                                **self.slo._counters())
+
+    # --- introspection -------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        snap = self.slo.snapshot(in_flight=len(self._in_flight))
+        snap.update(queue_depth=len(self._queue),
+                    draining=self._draining,
+                    stopped=self._stopped.is_set(),
+                    executables=len(self.cache),
+                    sessions=len(self._sessions))
+        return snap
+
+    def warmup(self, shapes, batch_sizes=(1,), iters=None,
+               warm: bool = False) -> int:
+        """AOT-precompile bucket programs for raw ``(H, W)`` shapes before
+        admitting traffic; returns the number compiled."""
+        keys = []
+        for h, w in shapes:
+            bh, bw = self._bucket_shape(h, w)
+            for b in batch_sizes:
+                keys.append(BucketKey(bh, bw, int(b),
+                                      int(iters or self.serve.default_iters),
+                                      warm))
+        return self.cache.warmup(keys)
+
+    # --- scheduler internals -------------------------------------------------
+
+    def _bucket_shape(self, h: int, w: int) -> Tuple[int, int]:
+        return (bucket_size(h, PAD_DIVIS, self.serve.bucket),
+                bucket_size(w, PAD_DIVIS, self.serve.bucket))
+
+    def _group_key(self, req: _Request) -> Tuple:
+        bh, bw = self._bucket_shape(*req.image1.shape[:2])
+        return (bh, bw, req.iters, req.warm)
+
+    def _collect(self, first: _Request) -> List[_Request]:
+        group = collect_group(
+            first, self._queue.get_nowait, self._queue.push_front,
+            self.serve.max_batch, key=self._group_key)
+        deadline = time.perf_counter() + self.serve.linger_s
+        k0 = self._group_key(first)
+        while (len(group) < self.serve.max_batch
+               and self.serve.linger_s > 0):
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            item = self._queue.get(timeout=remaining)
+            if item is None:
+                break
+            if self._group_key(item) != k0:
+                self._queue.push_front(item)
+                break
+            group.append(item)
+        return group
+
+    def _session_init(self, req: _Request, bh: int, bw: int) -> np.ndarray:
+        """The request's low-res warm-start field: the session's last
+        output, or zeros on a fresh/shape-changed session."""
+        factor = 2 ** self.cfg.n_downsample
+        shape = (bh // factor, bw // factor, 2)
+        state = self._sessions.get(req.stream or "")
+        if state is not None and state[0] == shape:
+            return state[1]
+        return np.zeros(shape, np.float32)
+
+    def _dispatch(self, group: List[_Request]) -> None:
+        bh, bw, iters, warm = self._group_key(group[0])
+        key = BucketKey(bh, bw, len(group), iters, warm)
+        padders = []
+        im1, im2, inits = [], [], []
+        t0 = time.perf_counter()
+        for req in group:
+            req.t_dispatch = t0
+            padder = InputPadder((1,) + req.image1.shape,
+                                 divis_by=PAD_DIVIS, target=(bh, bw))
+            p1, p2 = padder.pad(
+                req.image1[None].astype(np.float32),
+                req.image2[None].astype(np.float32))
+            padders.append(padder)
+            im1.append(np.asarray(p1)[0])
+            im2.append(np.asarray(p2)[0])
+            if warm:
+                inits.append(self._session_init(req, bh, bw))
+        try:
+            outputs = self.cache(
+                key, np.stack(im1), np.stack(im2),
+                np.stack(inits) if warm else None)
+        except Exception as exc:  # compile/shape failure: fail this batch
+            self._fail_group(group, key, exc, kind="dispatch")
+            return
+        self._in_flight.append((group, padders, key, outputs))
+
+    def _retire(self) -> None:
+        group, padders, key, outputs = self._in_flight.popleft()
+        try:
+            flow_lr, flow_up, finite = outputs
+            # the host fetch — the device-completion sync point
+            flow_lr = np.asarray(flow_lr)
+            flow_up = np.asarray(flow_up)
+            finite = np.asarray(finite)
+        except Exception as exc:  # device-side execution error
+            self._fail_group(group, key, exc, kind="dispatch")
+            return
+        now = time.perf_counter()
+        for j, req in enumerate(group):
+            if not bool(finite[j]):
+                # per-request isolation: THIS request failed; batchmates
+                # retire normally below. Poisoned sessions also reset so
+                # one NaN frame doesn't poison the warm-start chain.
+                if req.stream is not None:
+                    self._sessions.pop(req.stream, None)
+                self._finish(req, ServeResult(
+                    request_id=req.id, ok=False,
+                    error="non-finite values in request output",
+                    error_kind="nonfinite_output", stream=req.stream,
+                    latency_s=now - req.t_submit,
+                    queue_wait_s=req.t_dispatch - req.t_submit,
+                    batch_size=len(group), bucket=key.label()))
+                continue
+            flow = np.asarray(padders[j].unpad(flow_up[j:j + 1]))[0]
+            if req.warm and req.stream is not None:
+                self._sessions[req.stream] = (flow_lr[j].shape,
+                                              flow_lr[j])
+            self._finish(req, ServeResult(
+                request_id=req.id, ok=True, flow=flow, stream=req.stream,
+                latency_s=now - req.t_submit,
+                queue_wait_s=req.t_dispatch - req.t_submit,
+                batch_size=len(group), bucket=key.label()))
+
+    def _fail_group(self, group: List[_Request], key: BucketKey,
+                    exc: BaseException, kind: str) -> None:
+        now = time.perf_counter()
+        trace = "".join(tb_module.format_exception(
+            type(exc), exc, exc.__traceback__))
+        logger.warning("serve: batch %s failed (%s); failing %d request(s) "
+                       "individually, scheduler continues",
+                       key.label(), exc, len(group))
+        for req in group:
+            self._finish(req, ServeResult(
+                request_id=req.id, ok=False,
+                error=f"{type(exc).__name__}: {exc}", error_kind=kind,
+                traceback=trace, stream=req.stream,
+                latency_s=now - req.t_submit,
+                queue_wait_s=(req.t_dispatch or now) - req.t_submit,
+                batch_size=len(group), bucket=key.label()))
+
+    def _finish(self, req: _Request, result: ServeResult) -> None:
+        req.handle._set(result)
+        self.slo.retire(
+            request_id=req.id, status="ok" if result.ok else "error",
+            latency_s=result.latency_s, queue_wait_s=result.queue_wait_s,
+            bucket=result.bucket, batch_size=result.batch_size,
+            in_flight=len(self._in_flight), stream=req.stream,
+            error=result.error, traceback_tail=result.traceback)
+
+    def _run(self) -> None:
+        try:
+            while True:
+                self._apply_pending_reload()
+                while len(self._in_flight) >= max(1, self.serve.window):
+                    self._retire()
+                first = self._queue.get(timeout=0.05)
+                if first is None:
+                    if self._in_flight:
+                        self._retire()
+                    elif self._queue.closed and len(self._queue) == 0:
+                        break
+                    continue
+                self._dispatch(self._collect(first))
+            while self._in_flight:
+                self._retire()
+            self.slo.flush(in_flight=0)
+        finally:
+            self._stopped.set()
+            logger.info("serve: scheduler stopped (%s)",
+                        "drained" if self._draining else "exited")

@@ -1,0 +1,145 @@
+"""SLO telemetry for the serving loop (schema-v6 events).
+
+Three records ride the existing event bus (obs/telemetry.py):
+
+* ``request`` — one per retired request: terminal ``status`` (``ok`` /
+  ``error`` / ``rejected``), queue-wait and end-to-end latency, the bucket
+  and batch it rode, and — on failure — the captured error + traceback
+  (per-request fault isolation's paper trail);
+* ``queue`` — admission-side depth gauge (every ``gauge_every``-th
+  submit): queue depth, in-flight dispatches, admitted/completed/rejected
+  counters;
+* ``slo`` — the serving headline every ``emit_every`` retirements: p50/p99
+  end-to-end latency (ms) over a sliding sample window, current in-flight
+  depth, and sustained pairs/s over the same window — the numbers a
+  million-user deployment would alert on.
+
+The tracker is lock-guarded (scheduler thread retires, client threads
+admit) and, like every telemetry path in this repo, fail-open: with
+``telemetry=None`` it still aggregates, it just emits nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an ascending list (obs/compare.py's
+    convention); 0.0 on empty input."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[idx])
+
+
+class SLOTracker:
+    def __init__(self, telemetry=None, *, window: int = 256,
+                 emit_every: int = 16, gauge_every: int = 8):
+        self.telemetry = telemetry
+        self.window = max(1, int(window))
+        self.emit_every = max(1, int(emit_every))
+        self.gauge_every = max(1, int(gauge_every))
+        self._lock = threading.Lock()
+        # (retire wall-clock, latency seconds) per retired request
+        self._samples: "deque" = deque(maxlen=self.window)
+        self.admitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self._retired_since_emit = 0
+
+    # --- admission side ------------------------------------------------------
+
+    def admit(self, queue_depth: int, in_flight: int) -> None:
+        with self._lock:
+            self.admitted += 1
+            emit = self.admitted % self.gauge_every == 1 \
+                or self.gauge_every == 1
+            counters = self._counters()
+        if emit and self.telemetry is not None:
+            self.telemetry.emit("queue", depth=int(queue_depth),
+                                in_flight=int(in_flight), **counters)
+
+    def reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    # --- retirement side -----------------------------------------------------
+
+    def retire(self, request_id: str, status: str, latency_s: float,
+               queue_wait_s: float, bucket: str, batch_size: int,
+               in_flight: int, stream: Optional[str] = None,
+               error: Optional[str] = None,
+               traceback_tail: Optional[str] = None) -> None:
+        """Record one terminal request outcome; emits the ``request`` event
+        and, on cadence, the ``slo`` rollup."""
+        now = time.monotonic()
+        with self._lock:
+            if status == "ok":
+                self.completed += 1
+            else:
+                self.failed += 1
+            self._samples.append((now, float(latency_s)))
+            self._retired_since_emit += 1
+            do_slo = self._retired_since_emit >= self.emit_every
+            if do_slo:
+                self._retired_since_emit = 0
+                slo = self._snapshot_locked(in_flight)
+        if self.telemetry is not None:
+            payload: Dict[str, Any] = dict(
+                id=request_id, status=status,
+                latency_s=round(float(latency_s), 6),
+                queue_wait_s=round(float(queue_wait_s), 6),
+                bucket=bucket, batch_size=int(batch_size))
+            if stream is not None:
+                payload["stream"] = stream
+            if error is not None:
+                payload["error"] = error
+            if traceback_tail is not None:
+                payload["traceback"] = traceback_tail[-2000:]
+            self.telemetry.emit("request", **payload)
+            if do_slo:
+                self.telemetry.emit("slo", **slo)
+
+    # --- rollups -------------------------------------------------------------
+
+    def _counters(self) -> Dict[str, int]:
+        return {"admitted": self.admitted, "completed": self.completed,
+                "failed": self.failed, "rejected": self.rejected}
+
+    def _snapshot_locked(self, in_flight: int) -> Dict[str, Any]:
+        lats = sorted(l for _, l in self._samples)
+        span = (self._samples[-1][0] - self._samples[0][0]
+                if len(self._samples) > 1 else 0.0)
+        pairs = len(self._samples)
+        pps = pairs / span if span > 0 else 0.0
+        return {
+            "p50_ms": round(percentile(lats, 50) * 1e3, 3),
+            "p99_ms": round(percentile(lats, 99) * 1e3, 3),
+            "pairs_per_sec": round(pps, 4),
+            "in_flight": int(in_flight),
+            "window_requests": pairs,
+            **self._counters(),
+        }
+
+    def snapshot(self, in_flight: int = 0) -> Dict[str, Any]:
+        """Current rollup (the ``/slo`` HTTP endpoint + loadtest report)."""
+        with self._lock:
+            return self._snapshot_locked(in_flight)
+
+    def flush(self, in_flight: int = 0) -> None:
+        """Emit a final ``slo`` rollup regardless of cadence — called at
+        drain so short traces (< ``emit_every`` retirements) still leave
+        the headline record in events.jsonl."""
+        with self._lock:
+            if not self._samples:
+                return
+            self._retired_since_emit = 0
+            slo = self._snapshot_locked(in_flight)
+        if self.telemetry is not None:
+            self.telemetry.emit("slo", **slo)

@@ -56,6 +56,14 @@ rehearsal:
   checkpoint, and the injected-NaN drill must survive via the device-side
   anomaly guard. The exact-resume contract is a standing gate, not a
   docstring claim.
+* **serve** — the serving load drill (r12): ``python
+  scripts/load_drill.py --small`` — a budgeted CPU trace (2 shape
+  buckets, 4 concurrent clients incl. one warm-start video stream)
+  through the continuous-batching scheduler: the poisoned request must
+  fail alone, a mid-load SIGTERM must drain with zero lost admitted
+  requests, and ``cli compare`` must arbitrate served-vs-sequential
+  throughput from the phase's telemetry. The full >=3-bucket/8-client
+  acceptance record is banked separately in runs/load_drill/.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
@@ -200,13 +208,16 @@ def main(argv=None):
                     "driver's budgets (see module doc)")
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint", "fingerprint", "fault"],
+                            "scangrad", "lint", "fingerprint", "fault",
+                            "serve"],
                    choices=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint", "fingerprint", "fault"])
+                            "scangrad", "lint", "fingerprint", "fault",
+                            "serve"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
     p.add_argument("--fault-budget", type=float, default=1800.0)
+    p.add_argument("--serve-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -262,6 +273,13 @@ def main(argv=None):
             [sys.executable, os.path.join(REPO, "scripts",
                                           "fault_drill.py")],
             args.fault_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "serve" in args.legs:
+        records.append(run_leg(
+            "serve",
+            [sys.executable, os.path.join(REPO, "scripts", "load_drill.py"),
+             "--small", "--shapes", "48x96", "64x128",
+             "--clients", "4", "--requests", "3"],
+            args.serve_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

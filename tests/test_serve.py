@@ -1,0 +1,482 @@
+"""The serving subsystem (raft_stereo_tpu/serve):
+
+* batching units: collect_group policy + BoundedQueue semantics;
+* served-vs-direct bitwise parity per raw shape and per batch size
+  (the scheduler pads exactly like StereoPredictor, so a request's
+  result must not depend on who served it);
+* per-request fault isolation: a NaN-poisoned request retires as an
+  error while its BATCHMATE in the same dispatch stays bitwise-correct;
+  a dispatch-level exception fails exactly that batch with a captured
+  traceback and the scheduler keeps serving;
+* flow_init warm starts: a video session's second frame rides the
+  first frame's low-res flow (bitwise vs driving the executable cache
+  by hand);
+* hot reload: weights swap at a batch boundary without dropping queued
+  work, without recompiles, and a structure mismatch is rejected;
+* graceful drain: every admitted request completes, later submits are
+  rejected-not-lost;
+* PendingPrediction error capture (inference.py): a device error
+  surfaces as a caught-and-cached per-request failure, not a
+  half-fetched handle;
+* schema v6: request/queue/slo records validate, v5-stamped v6 events
+  flag drift, checked-in v1-v5 artifacts still lint clean;
+* cli-drift rule v3: the serve/loadtest parser surfaces fire on a
+  seeded orphan flag.
+"""
+
+import glob as globmod
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.inference import (PAD_DIVIS, PendingPrediction,
+                                       StereoPredictor, bucket_size)
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.obs import Telemetry, read_events
+from raft_stereo_tpu.obs.events import validate_record
+from raft_stereo_tpu.obs.validate import check_path
+from raft_stereo_tpu.ops.geometry import InputPadder
+from raft_stereo_tpu.serve import (BoundedQueue, BucketKey, QueueClosed,
+                                   ServeConfig, ServerDraining, SLOTracker,
+                                   StereoServer, collect_group)
+from raft_stereo_tpu.serve.server import ServeResult
+
+REPO = Path(__file__).resolve().parents[1]
+
+H, W = 48, 96
+ITERS = 2
+
+
+# ------------------------------------------------- batching policy units
+
+def _driver(items):
+    """(pull, push_back, log) over a mutable list."""
+    pushed = []
+
+    def pull():
+        return items.pop(0) if items else None
+
+    return pull, pushed.append, pushed
+
+
+def test_collect_group_greedy_same_key():
+    items = ["a1", "a2", "b1", "a3"]
+    pull, push, pushed = _driver(items)
+    group = collect_group("a0", pull, push, 10, key=lambda s: s[0])
+    assert group == ["a0", "a1", "a2"]
+    assert pushed == ["b1"]          # the break starts the next group
+    assert items == ["a3"]           # nothing beyond the break consumed
+
+
+def test_collect_group_limit_and_exhaustion():
+    items = ["a1", "a2"]
+    pull, push, pushed = _driver(items)
+    assert collect_group("a0", pull, push, 2,
+                         key=lambda s: s[0]) == ["a0", "a1"]
+    assert pushed == []
+    pull2, push2, _ = _driver([])
+    assert collect_group("x", pull2, push2, 4, key=len) == ["x"]
+
+
+def test_bounded_queue_fifo_pushfront_close():
+    q = BoundedQueue(2)
+    assert q.put("a", timeout=0.1) and q.put("b", timeout=0.1)
+    assert not q.put("c", timeout=0.05)     # full: timeout, not loss
+    assert q.get() == "a"
+    q.push_front("a0")                      # head re-insert
+    assert q.get() == "a0" and q.get() == "b"
+    assert q.get(timeout=0.05) is None
+    q.put("tail", timeout=0.1)
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.put("z", timeout=0.1)
+    assert q.get() == "tail"                # drain continues after close
+    assert q.get() is None                  # closed + empty: exit signal
+
+
+# ------------------------------------------------------- served parity
+
+@pytest.fixture(scope="module")
+def stack():
+    cfg = RAFTStereoConfig()
+    _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, H, W, 3))
+    predictor = StereoPredictor(cfg, variables, valid_iters=ITERS)
+    server = StereoServer(
+        cfg, variables,
+        ServeConfig(max_batch=2, window=2, default_iters=ITERS,
+                    linger_s=0.4))
+    yield cfg, variables, predictor, server
+    server.request_drain()
+    server.join(timeout=60)
+
+
+def _pair(seed, h=H, w=W, poison=False):
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, (h, w, 3)).astype(np.float32)
+    right = rng.integers(0, 255, (h, w, 3)).astype(np.float32)
+    if poison:
+        left[0, 0, 0] = np.nan
+    return left, right
+
+
+def test_served_bitwise_equals_predict_per_shape(stack):
+    """Two raw shapes padding into the SAME compiled bucket must each
+    come back bitwise-equal to the direct predictor."""
+    _, _, predictor, server = stack
+    for seed, (h, w) in enumerate([(H, W), (40, 80)]):
+        left, right = _pair(seed, h, w)
+        res = server.submit(left, right).result(timeout=300)
+        assert res.ok and res.flow.shape == (h, w, 1)
+        direct = predictor(left[None], right[None], ITERS)
+        np.testing.assert_array_equal(res.flow, direct[0])
+        assert res.disparity.shape == (h, w)
+        np.testing.assert_array_equal(res.disparity, -direct[0, ..., 0])
+
+
+def test_batched_dispatch_bitwise_and_poison_isolation(stack):
+    """Concurrent same-shape submits ride ONE dispatch; poisoning one of
+    them fails exactly that request while the batchmate's output stays
+    bitwise-identical to what it gets next to a CLEAN batchmate — the
+    NaN never crosses batch slots. (The b=2 executable's floats differ
+    from the b=1 one at ~1e-5 on XLA CPU — batch-size numerics — so the
+    direct-predict cross-check is allclose, not bitwise; the per-bucket
+    bitwise claim lives in test_served_bitwise_equals_predict_per_shape
+    where batch sizes match.)"""
+    cfg, _, predictor, server = stack
+    clean_l, clean_r = _pair(10)
+    bad_l, bad_r = _pair(11, poison=True)
+    h_clean = server.submit(clean_l, clean_r)
+    h_bad = server.submit(bad_l, bad_r)
+    r_clean = h_clean.result(timeout=300)
+    r_bad = h_bad.result(timeout=300)
+    # the linger window packs the back-to-back submits into one dispatch
+    assert r_clean.batch_size == 2 and r_bad.batch_size == 2
+    assert r_clean.bucket == r_bad.bucket
+    assert not r_bad.ok
+    assert r_bad.error_kind == "nonfinite_output"
+    assert r_bad.flow is None
+    assert r_clean.ok
+    # NaN isolation: drive the SAME b=2 executable by hand with the
+    # poisoned batchmate swapped for a clean one — slot 0 must not move
+    # by a single bit
+    bh = bucket_size(H, PAD_DIVIS, 0)
+    bw = bucket_size(W, PAD_DIVIS, 0)
+    key = BucketKey(bh, bw, 2, ITERS, False)
+    padder = InputPadder((1, H, W, 3), divis_by=PAD_DIVIS, target=(bh, bw))
+    alt_l, alt_r = _pair(13)
+    def batch(mate_l, mate_r):
+        ims = [padder.pad(l[None], r[None])
+               for l, r in ((clean_l, clean_r), (mate_l, mate_r))]
+        im1 = np.concatenate([np.asarray(p[0]) for p in ims])
+        im2 = np.concatenate([np.asarray(p[1]) for p in ims])
+        return server.cache(key, im1, im2, None)
+    _, up_bad_mate, finite_bad = (np.asarray(o) for o in
+                                  batch(bad_l, bad_r))
+    _, up_clean_mate, finite_clean = (np.asarray(o) for o in
+                                      batch(alt_l, alt_r))
+    assert list(finite_bad) == [True, False]
+    assert list(finite_clean) == [True, True]
+    np.testing.assert_array_equal(up_bad_mate[0], up_clean_mate[0])
+    # and the served result IS that executable's slot-0 output
+    np.testing.assert_array_equal(
+        r_clean.flow, np.asarray(padder.unpad(up_bad_mate[0:1]))[0])
+    # cross-batch-size sanity vs the direct b=1 predictor
+    direct = predictor(clean_l[None], clean_r[None], ITERS)
+    np.testing.assert_allclose(r_clean.flow, direct[0],
+                               rtol=5e-3, atol=1e-3)
+    # the scheduler survived: a fresh request still serves
+    left, right = _pair(12)
+    assert server.submit(left, right).result(timeout=300).ok
+
+
+def test_video_stream_warm_start_chains_flow_init(stack):
+    """Frame 2 of a video session must ride frame 1's low-res flow:
+    bitwise-equal to driving the warm executable by hand, and different
+    from a cold (zero-init) pass over the same frame."""
+    cfg, _, _, server = stack
+    bh = bucket_size(H, PAD_DIVIS, 0)
+    bw = bucket_size(W, PAD_DIVIS, 0)
+    factor = 2 ** cfg.n_downsample
+    l1, r1 = _pair(20)
+    l2, r2 = _pair(21)
+    res1 = server.submit(l1, r1, stream="cam", warm_start=True) \
+        .result(timeout=300)
+    res2 = server.submit(l2, r2, stream="cam", warm_start=True) \
+        .result(timeout=300)
+    assert res1.ok and res2.ok and res1.bucket.endswith("w")
+    key = BucketKey(bh, bw, 1, ITERS, True)
+    padder = InputPadder((1, H, W, 3), divis_by=PAD_DIVIS, target=(bh, bw))
+    zeros = np.zeros((1, bh // factor, bw // factor, 2), np.float32)
+    p1 = [np.asarray(x) for x in padder.pad(l1[None], r1[None])]
+    p2 = [np.asarray(x) for x in padder.pad(l2[None], r2[None])]
+    lr1, up1, _ = (np.asarray(o) for o in server.cache(key, *p1, zeros))
+    np.testing.assert_array_equal(res1.flow,
+                                  np.asarray(padder.unpad(up1))[0])
+    _, up2_warm, _ = (np.asarray(o)
+                      for o in server.cache(key, *p2, lr1))
+    np.testing.assert_array_equal(res2.flow,
+                                  np.asarray(padder.unpad(up2_warm))[0])
+    _, up2_cold, _ = (np.asarray(o)
+                      for o in server.cache(key, *p2, zeros))
+    assert not np.array_equal(up2_warm, up2_cold)
+
+
+def test_hot_reload_swaps_weights_without_drop_or_recompile(stack):
+    """reload() must change served outputs, complete every queued
+    request, add no executables, and reject a structure mismatch."""
+    _, variables, predictor, server = stack
+    left, right = _pair(30)
+    before = server.submit(left, right).result(timeout=300)
+    assert before.ok
+    n_exec = len(server.cache)
+    scaled = jax.tree.map(lambda l: l * 0.5, variables)
+    handles = [server.submit(*_pair(31 + i)) for i in range(3)]
+    server.reload(scaled, note="test-swap")
+    handles.append(server.submit(left, right))
+    results = [h.result(timeout=300) for h in handles]
+    assert all(r.ok for r in results)          # nothing dropped
+    after = server.submit(left, right).result(timeout=300)
+    assert after.ok
+    assert not np.array_equal(after.flow, before.flow)
+    # variables are a runtime argument: same executables serve new weights
+    assert len(server.cache) == n_exec
+    old_vars = predictor.variables
+    try:
+        predictor.variables = scaled
+        direct = predictor(left[None], right[None], ITERS)
+    finally:
+        predictor.variables = old_vars
+    np.testing.assert_array_equal(after.flow, direct[0])
+    with pytest.raises(ValueError):
+        server.reload({"params": {"bogus": np.zeros(3, np.float32)}})
+    server.reload(variables)                   # restore for later tests
+
+
+def test_drain_completes_admitted_rejects_new(stack):
+    """request_drain(): every admitted request retires, later submits
+    raise ServerDraining, the scheduler thread exits. Runs LAST — it
+    shuts the module server down (the SIGTERM path in cli/load_drill
+    is this plus a SignalGuard)."""
+    _, _, _, server = stack
+    handles = [server.submit(*_pair(40 + i)) for i in range(4)]
+    server.request_drain()
+    with pytest.raises(ServerDraining):
+        server.submit(*_pair(50))
+    results = [h.result(timeout=300) for h in handles]
+    assert all(r.ok for r in results)
+    assert server.join(timeout=120)
+    stats = server.stats()
+    assert stats["queue_depth"] == 0 and stats["in_flight"] == 0
+    assert stats["rejected"] >= 1
+
+
+# ------------------------------------- scheduler survives device errors
+
+class _ExplodingCache:
+    """Stands in for ExecutableCache: the dispatch itself raises."""
+
+    def __call__(self, key, im1, im2, flow_init=None):
+        raise RuntimeError("synthetic device failure")
+
+
+class _FakeCache:
+    """Instant fake executable: constant finite outputs."""
+
+    def __call__(self, key, im1, im2, flow_init=None):
+        b, h, w, _ = im1.shape
+        return (np.zeros((b, h // 4, w // 4, 2), np.float32),
+                np.full((b, h, w, 1), 7.0, np.float32),
+                np.ones((b,), bool))
+
+
+def _light_server(tmp_path, cache, telemetry=None, **kw):
+    cfg = RAFTStereoConfig()
+    _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, H, W, 3))
+    server = StereoServer(
+        cfg, variables,
+        ServeConfig(max_batch=2, window=2, default_iters=ITERS,
+                    linger_s=0.2, slo_every=2, **kw),
+        telemetry=telemetry, autostart=False)
+    server.cache = cache
+    return server
+
+
+def test_dispatch_failure_fails_batch_not_scheduler(tmp_path):
+    tel = Telemetry(str(tmp_path / "run"), stall_deadline_s=None)
+    server = _light_server(tmp_path, _ExplodingCache(), telemetry=tel)
+    server.start()
+    handles = [server.submit(*_pair(60 + i)) for i in range(2)]
+    results = [h.result(timeout=60) for h in handles]
+    assert all(not r.ok for r in results)
+    assert all(r.error_kind == "dispatch" for r in results)
+    assert all("synthetic device failure" in r.error for r in results)
+    assert all("RuntimeError" in r.traceback for r in results)
+    # the scheduler thread survived the exploding batch
+    server.cache = _FakeCache()
+    assert server.submit(*_pair(62)).result(timeout=60).ok
+    server.request_drain()
+    assert server.join(timeout=60)
+    tel.close()
+    events = read_events(str(tmp_path / "run" / "events.jsonl"))
+    failed = [e for e in events if e.get("event") == "request"
+              and e.get("status") == "error"]
+    assert failed and all("RuntimeError" in e["traceback"] for e in failed)
+    assert check_path(str(tmp_path / "run")) == []
+
+
+def test_drain_on_unstarted_server_completes_inline(tmp_path):
+    server = _light_server(tmp_path, _FakeCache())
+    handles = [server.submit(*_pair(70 + i)) for i in range(3)]
+    assert server.close(timeout=60)
+    assert all(h.result(timeout=5).ok for h in handles)
+
+
+# --------------------------------------- PendingPrediction error capture
+
+class _ExplodingArray:
+    def __array__(self, *a, **kw):
+        raise RuntimeError("device said no")
+
+
+def test_pending_prediction_captures_fetch_error():
+    pending = PendingPrediction(_ExplodingArray(), lambda x: x, 0.01)
+    with pytest.raises(RuntimeError, match="device said no"):
+        pending.result()
+    assert isinstance(pending.exception(), RuntimeError)
+    assert pending.fetch_s is not None
+    assert pending._flow is None              # buffer reference released
+    with pytest.raises(RuntimeError, match="device said no"):
+        pending.result()                      # idempotent re-raise
+
+
+def test_pending_prediction_success_path_unchanged():
+    arr = np.ones((1, 4, 4, 1), np.float32)
+    pending = PendingPrediction(arr, lambda x: x, 0.01)
+    np.testing.assert_array_equal(pending.result(), arr)
+    assert pending.exception() is None
+
+
+# ------------------------------------------------------- schema v6 / SLO
+
+def test_v6_records_validate_and_v5_stamp_is_drift():
+    ok = {"schema": 6, "ts": "2026-01-01T00:00:00",
+          "event": "slo", "p50_ms": 10.0, "p99_ms": 20.0,
+          "pairs_per_sec": 3.0, "in_flight": 1}
+    assert validate_record(ok) == []
+    assert validate_record({**ok, "schema": 5})  # introduced-in-v6 drift
+    assert validate_record({"schema": 6, "ts": "t", "event": "request",
+                            "id": "r1", "status": "ok"}) == []
+    assert validate_record({"schema": 6, "ts": "t", "event": "queue",
+                            "depth": 4}) == []
+    missing = validate_record({"schema": 6, "ts": "t", "event": "request",
+                               "id": "r1"})
+    assert any("status" in e for e in missing)
+
+
+def test_checked_in_artifacts_still_lint_clean_under_v6():
+    """The v5 -> v6 bump is additive: every banked events.jsonl from
+    earlier rounds must still validate."""
+    artifacts = sorted(globmod.glob(str(REPO / "runs" / "*" /
+                                        "events.jsonl")))
+    assert artifacts, "expected banked run artifacts in runs/"
+    for path in artifacts:
+        assert check_path(path) == [], path
+
+
+def test_slo_tracker_emits_valid_rollups(tmp_path):
+    tel = Telemetry(str(tmp_path / "slo"), stall_deadline_s=None)
+    slo = SLOTracker(tel, window=8, emit_every=2, gauge_every=1)
+    for i in range(4):
+        slo.admit(queue_depth=i, in_flight=1)
+        slo.retire(request_id=f"r{i}", status="ok" if i else "error",
+                   latency_s=0.01 * (i + 1), queue_wait_s=0.001,
+                   bucket="64x96b1i2", batch_size=1, in_flight=1,
+                   error=None if i else "boom",
+                   traceback_tail=None if i else "T" * 3000)
+    tel.close()
+    events = read_events(str(tmp_path / "slo" / "events.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert kinds.count("queue") == 4 and kinds.count("request") == 4
+    rollups = [e for e in events if e["event"] == "slo"]
+    assert len(rollups) == 2
+    assert rollups[-1]["p99_ms"] >= rollups[-1]["p50_ms"] > 0
+    assert rollups[-1]["completed"] == 3 and rollups[-1]["failed"] == 1
+    boom = next(e for e in events if e.get("status") == "error")
+    assert len(boom["traceback"]) == 2000     # tail-truncated
+    assert check_path(str(tmp_path / "slo")) == []
+    snap = slo.snapshot(in_flight=0)
+    assert snap["window_requests"] == 4
+
+
+# ------------------------------------------------- cli surfaces + lint
+
+def test_serve_parsers_defaults_and_shapes():
+    from raft_stereo_tpu.cli import (_parse_shapes, build_loadtest_parser,
+                                     build_serve_parser, serve_config)
+    args = build_serve_parser().parse_args([])
+    cfg = serve_config(args)
+    assert cfg.max_batch == 4 and cfg.window == 2 and cfg.aot
+    lt = build_loadtest_parser().parse_args(["--poison_at", "5"])
+    assert lt.poison_at == 5 and lt.clients == 8
+    assert len(set(lt.shapes)) >= 3
+    assert _parse_shapes(["48x96", "128X64"]) == [(48, 96), (128, 64)]
+
+
+def test_cli_main_knows_serve_and_loadtest(capsys):
+    from raft_stereo_tpu.cli import main
+    assert main([]) == 2
+    usage = capsys.readouterr().err
+    assert "serve" in usage and "loadtest" in usage
+
+
+def test_cli_drift_v3_fires_on_seeded_serve_fixture(tmp_path):
+    """Rule v3: an orphan flag on either serving surface is an error."""
+    from raft_stereo_tpu.analysis.ast_rules import (
+        RULE_VERSIONS, check_entry_surface_drift)
+
+    assert RULE_VERSIONS["cli-drift"] == 3
+    pkg = tmp_path / "raft_stereo_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "cli.py").write_text(
+        "def build_serve_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('--port')\n"
+        "    p.add_argument('--serve_orphan')\n"
+        "    return p\n"
+        "def build_loadtest_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('--clients')\n"
+        "    p.add_argument('--loadtest_orphan')\n"
+        "    return p\n"
+        "def _serve_main():\n"
+        "    args = build_serve_parser().parse_args()\n"
+        "    print(args.port)\n")
+    (pkg / "serve" / "loadtest.py").write_text(
+        "def run(args):\n"
+        "    return args.clients\n")
+    findings = check_entry_surface_drift(str(tmp_path))
+    orphans = {f.data.get("dest") for f in findings
+               if f.rule == "cli-drift" and f.severity == "error"}
+    assert orphans == {"serve_orphan", "loadtest_orphan"}
+
+
+def test_loadtest_trace_covers_required_mix():
+    from raft_stereo_tpu.serve.loadtest import LoadTestConfig
+    lt = LoadTestConfig(clients=8, requests_per_client=4, video_streams=1,
+                        poison_at=9)
+    trace = lt.trace()
+    assert len(trace) == 8
+    shapes = {spec["shape"] for client in trace for spec in client}
+    assert len(shapes) >= 3
+    videos = [s for client in trace for s in client if s["video"]]
+    assert videos and all(s["stream"] == "video0" for s in videos)
+    poisoned = [s for client in trace for s in client if s["poison"]]
+    assert len(poisoned) == 1 and poisoned[0]["ordinal"] == 9
