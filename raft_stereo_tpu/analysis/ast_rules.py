@@ -41,15 +41,18 @@ from raft_stereo_tpu.analysis.findings import Finding
 
 #: current semantic version per rule (baseline entries record the version
 #: they suppress; a bump flags them stale — findings.apply_baseline).
-#: cli-drift is v3: v2 extended the rule to the evaluate_stereo/demo
-#: parser surfaces and the bench config-constructor call sites; v3 adds
-#: the serving surfaces (build_serve_parser/build_loadtest_parser), so
-#: earlier suppressions no longer mean what they said.
+#: cli-drift is v4: v2 extended the rule to the evaluate_stereo/demo
+#: parser surfaces and the bench config-constructor call sites; v3 added
+#: the serving surfaces (build_serve_parser/build_loadtest_parser); v4
+#: adds the tracing/diagnosis surfaces (build_timeline_parser/
+#: build_doctor_parser, consumed by obs/timeline.py and obs/doctor.py)
+#: plus the serve --no_metrics plumbing — so earlier suppressions no
+#: longer mean what they said.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 3,
+    "cli-drift": 4,
 }
 
 # Call names (last attribute segment) that trace their function arguments.
@@ -476,6 +479,12 @@ ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("build_serve_parser", ("raft_stereo_tpu/cli.py",)),
     ("build_loadtest_parser", ("raft_stereo_tpu/cli.py",
                                "raft_stereo_tpu/serve/loadtest.py")),
+    # tracing/diagnosis surfaces (rule v4): the parsers are declared in
+    # cli.py, their mains live next to the implementations
+    ("build_timeline_parser", ("raft_stereo_tpu/cli.py",
+                               "raft_stereo_tpu/obs/timeline.py")),
+    ("build_doctor_parser", ("raft_stereo_tpu/cli.py",
+                             "raft_stereo_tpu/obs/doctor.py")),
 )
 
 #: modules whose own argparse surface must be self-consumed, and whose

@@ -148,6 +148,10 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                    help="stall-watchdog deadline: warn + emit a `stall` "
                         "event when no step completes within this many "
                         "seconds (0 disables)")
+    o.add_argument("--no_trace", action="store_true",
+                   help="disable span tracing (obs/trace.py): no schema-v7 "
+                        "`span` records, no cli timeline/doctor phase "
+                        "breakdown for this run")
     f = parser.add_argument_group(
         "fault tolerance", "atomic checkpoints, preemption handling and "
         "the device-side anomaly guard (training/resilience.py; drill: "
@@ -198,6 +202,7 @@ def train_config(args: argparse.Namespace) -> TrainConfig:
         grad_accum_steps=args.grad_accum_steps,
         run_dir=args.run_dir,
         stall_deadline_s=args.stall_deadline_s or None,
+        trace=not args.no_trace,
         checkpoint_frequency=args.checkpoint_frequency,
         ckpt_keep_last=args.ckpt_keep_last,
         ckpt_keep_every=args.ckpt_keep_every,
@@ -360,8 +365,36 @@ def build_serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--drain_timeout_s", type=float, default=300.0,
                         help="max seconds to finish admitted work after "
                              "SIGTERM/SIGINT before giving up (exit 1)")
+    parser.add_argument("--no_metrics", action="store_true",
+                        help="disable the Prometheus GET /metrics "
+                             "exposition endpoint (serve/http.py)")
     add_serve_args(parser)
     add_model_args(parser)
+    return parser
+
+
+def build_timeline_parser() -> argparse.ArgumentParser:
+    """The ``cli timeline`` flag surface (consumed by obs/timeline.py)."""
+    parser = argparse.ArgumentParser(
+        prog="cli timeline",
+        description="Export a run's span/event/device timeline as "
+                    "Chrome/Perfetto trace JSON")
+    parser.add_argument("run_dir", help="run directory holding events.jsonl")
+    parser.add_argument("--out", default=None,
+                        help="output path (default <run_dir>/timeline.json)")
+    return parser
+
+
+def build_doctor_parser() -> argparse.ArgumentParser:
+    """The ``cli doctor`` flag surface (consumed by obs/doctor.py)."""
+    parser = argparse.ArgumentParser(
+        prog="cli doctor",
+        description="Rule-driven bottleneck diagnosis over a run's "
+                    "events + spans")
+    parser.add_argument("run_dir",
+                        help="run directory (or events.jsonl path)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON instead of text")
     return parser
 
 
@@ -418,7 +451,9 @@ def _serve_main():
     tel = None
     if args.run_dir:
         from raft_stereo_tpu.obs import Telemetry
+        from raft_stereo_tpu.obs.trace import Tracer
         tel = Telemetry(args.run_dir, stall_deadline_s=None)
+        Tracer(tel)  # request-lifecycle spans (attaches as tel.tracer)
         tel.run_start(config={"mode": "serve", "port": args.port,
                               "max_batch": args.max_batch,
                               "window": args.window, "iters": args.iters})
@@ -445,7 +480,8 @@ def _serve_main():
         _, fresh = load_variables(ckpt, cfg)
         server.reload(fresh, note=ckpt)
 
-    httpd = make_http_server(server, args.host, args.port)
+    httpd = make_http_server(server, args.host, args.port,
+                             metrics=not args.no_metrics)
     with SignalGuard() as guard:
         rc = serve_forever(server, httpd,
                            should_stop=lambda: guard.requested,
@@ -503,6 +539,8 @@ def _loadtest_main():
               flush=True)
     tel = Telemetry(os.path.join(args.run_dir, "serve"),
                     stall_deadline_s=None)
+    from raft_stereo_tpu.obs.trace import Tracer
+    Tracer(tel)  # request-lifecycle spans (attaches as tel.tracer)
     tel.run_start(config={"mode": "loadtest-serve"})
     server = StereoServer(cfg, variables, serve_config(args), telemetry=tel)
     # AOT-warm every program the trace can reach — cold buckets at every
@@ -631,6 +669,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     * ``lint [--graph|--ast]`` — graftlint: jaxpr/HLO contract rules +
       tracer-safety AST lint (raft_stereo_tpu/analysis/; exit 1 on
       unsuppressed error-severity findings),
+    * ``timeline <run_dir>`` — export the run's span/event/device-trace
+      timeline as Chrome/Perfetto JSON (obs/timeline.py),
+    * ``doctor <run_dir>`` — rule-driven bottleneck diagnosis with
+      evidence lines (obs/doctor.py),
     * ``serve`` — continuous-batching HTTP serving with SLO telemetry,
       graceful drain and SIGHUP hot reload (raft_stereo_tpu/serve),
     * ``loadtest`` — the synthetic many-client serving drill vs a
@@ -641,8 +683,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    commands = ("telemetry", "compare", "lint", "train", "eval", "serve",
-                "loadtest")
+    commands = ("telemetry", "compare", "lint", "timeline", "doctor",
+                "train", "eval", "serve", "loadtest")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -657,6 +699,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "lint":
         from raft_stereo_tpu.analysis.runner import main as lint_main
         return lint_main(rest)
+    if cmd == "timeline":
+        from raft_stereo_tpu.obs.timeline import main as timeline_main
+        return timeline_main(rest)
+    if cmd == "doctor":
+        from raft_stereo_tpu.obs.doctor import main as doctor_main
+        return doctor_main(rest)
     # the remaining mains parse sys.argv via argparse; present the
     # remainder as the whole command line
     sys.argv = [f"{sys.argv[0]} {cmd}"] + rest

@@ -272,6 +272,12 @@ class TrainConfig:
     # step to let initial compilation through). None/0 disables the watchdog.
     run_dir: str = "runs"
     stall_deadline_s: Optional[float] = 300.0
+    # Span tracing (obs/trace.py): step/data_wait/dispatch/fetch spans +
+    # loader spans on the event bus, feeding `cli timeline`/`cli doctor`
+    # and the flight recorder. Cheap enough to leave on (ring-buffered,
+    # reuses the step loop's existing perf_counter stamps); False yields
+    # the null tracer and a span-free events.jsonl.
+    trace: bool = True
     # Fault tolerance (training/resilience.py). Checkpoint cadence in
     # steps; None rides validation_frequency (the pre-r11 behavior —
     # checkpoints only ever landed beside validations). A preemptible-pod

@@ -34,6 +34,8 @@ from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
 
+from raft_stereo_tpu.obs.trace import NULL_TRACER
+
 logger = logging.getLogger(__name__)
 
 BATCH_FIELDS = ("image1", "image2", "flow", "valid")
@@ -93,6 +95,10 @@ class Loader:
         # producer thread with queue-depth/wait gauges every GAUGE_EVERY
         # batches. Must never raise into the pipeline — calls are guarded.
         self.gauge_hook: Optional[Callable[[Dict], None]] = None
+        # Optional span tracer (obs/trace.py; set by the trainer alongside
+        # gauge_hook): the producer thread records loader/produce spans
+        # with decode/put legs, and quarantines record their scan window.
+        self.tracer = None
         # Consumed by the NEXT __iter__ only (then reset): resume support.
         # Because sample (epoch, index) fully determines decode + augment
         # (Philox keying below), skipping the first k batches of the
@@ -140,6 +146,7 @@ class Loader:
         # with the ORIGINAL slot's rng — every other sample in the stream
         # stays bitwise identical, so resume reproduces the same stream
         n = len(self.dataset)
+        tq0 = time.perf_counter()
         for k in range(1, min(n, self._QUARANTINE_SCAN)):
             sub = (index + k) % n
             try:
@@ -160,6 +167,9 @@ class Loader:
                     self.quarantine_hook(dict(record))
                 except Exception:
                     self.quarantine_hook = None  # never break the pipeline
+            (self.tracer or NULL_TRACER).record(
+                "loader/quarantine", tq0, time.perf_counter(),
+                epoch=epoch, index=int(index), substitute=int(sub))
             return sample
         raise error
 
@@ -185,6 +195,7 @@ class Loader:
 
         def produce():
             decode_wait = put_wait = 0.0
+            tracer = self.tracer or NULL_TRACER
             with ThreadPoolExecutor(self.num_workers) as pool:
                 # pipeline sample futures one batch ahead of consumption
                 futures = [pool.submit(self._sample_resilient, epoch, int(i))
@@ -201,17 +212,25 @@ class Loader:
                             int(order[submitted])))
                         submitted += 1
                     try:
-                        t0 = time.perf_counter()
+                        tb0 = time.perf_counter()
                         batch = _collate([f.result() for f in batch_futs])
-                        decode_wait += time.perf_counter() - t0
+                        td = time.perf_counter()
+                        decode_wait += td - tb0
                     except Exception as e:  # propagate to consumer
                         out.put(e)
                         return
                     if stop.is_set():
                         return
-                    t0 = time.perf_counter()
                     out.put(batch)
-                    put_wait += time.perf_counter() - t0
+                    tp = time.perf_counter()
+                    put_wait += tp - td
+                    # retroactive spans from the stamps just taken: decode
+                    # (future-wait + collate) and put (blocked on a full
+                    # prefetch queue) tile the produce root
+                    root = tracer.record("loader/produce", tb0, tp,
+                                         batch=b, epoch=epoch)
+                    tracer.record("loader/decode", tb0, td, parent=root)
+                    tracer.record("loader/put_wait", td, tp, parent=root)
                     if self.gauge_hook is not None and b % GAUGE_EVERY == 0:
                         try:
                             # queue_depth: batches banked ahead of the

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Optional, Union
 
 import numpy as np
 
+from raft_stereo_tpu.obs.trace import NULL_TRACER
 from raft_stereo_tpu.serve.batching import collect_group, stack_pairs
 
 logger = logging.getLogger(__name__)
@@ -146,6 +147,7 @@ def _emit_step(telemetry, index: int, timing: FrameTiming) -> None:
 
 
 def _run_sequential(predictor, dataset, consume, iters, telemetry, timed):
+    tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
     for i in range(len(dataset)):
         t_load = time.perf_counter()
         sample = dataset.sample(i)
@@ -158,6 +160,9 @@ def _run_sequential(predictor, dataset, consume, iters, telemetry, timed):
                              iters)
             dt_dev = None
         t1 = time.perf_counter()
+        root = tracer.record("eval/frame", t_load, t1, index=i)
+        tracer.record("eval/decode", t_load, t0, parent=root)
+        tracer.record("eval/predict", t0, t1, parent=root)
         # historical split (eval/validate.py r5 KITTI loop): dispatch is the
         # device forward where measured, fetch the pad/transfer overhead
         # around it; untimed validators can't split the single blocking call
@@ -171,6 +176,7 @@ def _run_sequential(predictor, dataset, consume, iters, telemetry, timed):
 
 
 def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
+    tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
     n = len(dataset)
     window = max(1, cfg.window)
     microbatch = max(1, cfg.microbatch)
@@ -203,10 +209,22 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
 
     def retire():
         nonlocal t_last_retire
-        group, handle, dispatch_s, data_wait_s = in_flight.popleft()
+        group, handle, dispatch_s, data_wait_s, stamps = in_flight.popleft()
+        tr0 = time.perf_counter()
         flows = handle.result()  # (B, H, W, 1); blocks until the device is done
+        tr1 = time.perf_counter()
         fetch_s = getattr(handle, "fetch_s", None) or 0.0
         b = len(group)
+        # one span tree per micro-batch group, from the first decode pull
+        # to the result fetch; decode_wait is the summed future-wait
+        # charged at the group's start
+        tg0, td0, td1 = stamps
+        root = tracer.record("eval/frames", tg0, tr1, frames=b,
+                             first_index=group[0][0])
+        tracer.record("eval/decode_wait", tg0, tg0 + data_wait_s,
+                      parent=root)
+        tracer.record("eval/dispatch", td0, td1, parent=root)
+        tracer.record("eval/fetch", tr0, tr1, parent=root)
         for j, (idx, sample) in enumerate(group):
             now = time.perf_counter()
             timing = FrameTiming(
@@ -223,6 +241,7 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
         while pending or decoded or next_submit < n or in_flight:
             frames_left = pending or decoded or next_submit < n
             if frames_left and len(in_flight) < window:
+                tg0 = time.perf_counter()
                 idx0, s0, wait = take_decoded()
                 fill()
                 # stack consecutive same-shape frames into one dispatch;
@@ -248,8 +267,10 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
                 im1, im2 = stack_pairs([s for _, s in group])
                 t0 = time.perf_counter()
                 handle = predictor.predict_async(im1, im2, iters)
-                dispatch_s = time.perf_counter() - t0
-                in_flight.append((group, handle, dispatch_s, wait))
+                t1 = time.perf_counter()
+                dispatch_s = t1 - t0
+                in_flight.append((group, handle, dispatch_s, wait,
+                                  (tg0, t0, t1)))
                 dispatches += 1
                 if telemetry is not None and \
                         dispatches % GAUGE_EVERY == 1:

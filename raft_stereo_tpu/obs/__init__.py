@@ -16,7 +16,12 @@ The three pieces every entry point shares:
   memory/cost analyses into ``xla_memory``/``xla_cost`` events;
 * the regression gate (obs/compare.py) — ``python -m raft_stereo_tpu.cli
   compare <baseline> <candidate>`` diffs two runs' event logs against
-  thresholds and exits non-zero on regression.
+  thresholds and exits non-zero on regression;
+* span tracing (obs/trace.py) — :class:`Tracer` rides the event bus with
+  schema-v7 ``span`` records (trainer step phases, loader produce legs,
+  eval frames, serve request lifecycle); consumed by ``cli timeline``
+  (obs/timeline.py), ``cli doctor`` (obs/doctor.py) and the telemetry
+  flight recorder.
 """
 
 from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
@@ -25,6 +30,8 @@ from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
                                         read_events, validate_events,
                                         validate_record)
 from raft_stereo_tpu.obs.telemetry import Telemetry
+from raft_stereo_tpu.obs.trace import (NULL_TRACER, Span, Tracer,
+                                       tracer_for)
 from raft_stereo_tpu.obs.validate import check_path, check_paths
 from raft_stereo_tpu.obs.summarize import format_summary, summarize_run
 from raft_stereo_tpu.obs.xla import (compact_xla_summary,
@@ -35,6 +42,7 @@ __all__ = [
     "EVENT_TYPES", "SCHEMA_VERSION", "SUPPORTED_SCHEMA_VERSIONS",
     "append_json_log", "make_record", "read_events", "validate_events",
     "validate_record", "check_path", "check_paths", "Telemetry",
+    "NULL_TRACER", "Span", "Tracer", "tracer_for",
     "format_summary", "summarize_run",
     "introspect_compiled", "compact_xla_summary", "compare_runs",
 ]

@@ -1,0 +1,239 @@
+"""``cli doctor``: name the dominant bottleneck, with evidence.
+
+A rule-driven diagnosis pass over a run's events + spans that replaces
+eyeballing ``cli telemetry`` output. One verdict per phase present in the
+log (train steps and/or served requests), each with the evidence lines
+that earned it:
+
+* **STALLED** — the watchdog fired: something wedged outright (the
+  tunneled-TPU failure mode PERF.md documents). Trumps everything: rate
+  analysis of a wedged run is noise.
+* **COMPILE_STORM** — repeated compilations ate a large share of the
+  wall clock (shape churn / cache misses); fix compilation, not the
+  steady state.
+* **QUEUE_SATURATED** (serve) — requests spend most of their latency
+  waiting for admission into a batch: offered load exceeds service rate;
+  scale out or shed harder.
+* **DATA_STARVED** (train) — the step loop blocks on the loader: the
+  median step's data_wait share is dominant and the prefetch queue runs
+  empty. More decode workers/prefetch, not a faster model, is the fix.
+* **COMPUTE_BOUND** — the device-side phases dominate; the pipeline is
+  healthy and further wins come from the model/compiler (the ROADMAP's
+  serial-floor work).
+* **BALANCED** — nothing dominates; **UNKNOWN** only when the log holds
+  no usable evidence at all.
+
+Rules read the ``step``/``request``/``slo``/``loader``/``stall``/
+``compile`` records (all pre-v7), so doctor works on old artifacts too;
+v7 spans sharpen the serve phase split when present.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from raft_stereo_tpu.obs.events import read_events
+from raft_stereo_tpu.obs.summarize import _percentiles
+
+# rule thresholds (fractions of wall / latency)
+COMPILE_STORM_MIN_EVENTS = 3
+COMPILE_STORM_WALL_FRAC = 0.5
+DATA_STARVED_FRAC = 0.4
+COMPUTE_BOUND_FRAC = 0.6
+QUEUE_SATURATED_FRAC = 0.5
+
+
+def _median(xs: Sequence[float]) -> float:
+    return _percentiles(list(xs))["p50"] if xs else 0.0
+
+
+def _verdict(phase: str, verdict: str,
+             evidence: List[str]) -> Dict[str, Any]:
+    return {"phase": phase, "verdict": verdict, "evidence": evidence}
+
+
+def _wall_s(records: Sequence[Dict[str, Any]]) -> float:
+    ts = [float(r["t"]) for r in records if "t" in r]
+    return (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+
+
+def _check_stall(records, phase: str) -> Optional[Dict[str, Any]]:
+    stalls = [r for r in records if r.get("event") == "stall"]
+    if not stalls:
+        return None
+    worst = max(float(r.get("seconds_since_step", 0.0)) for r in stalls)
+    return _verdict(phase, "STALLED", [
+        f"stall watchdog fired {len(stalls)}x; worst gap "
+        f"{worst:.1f}s since the last completed step",
+        "rate analysis suppressed: a wedged run's steady-state numbers "
+        "are noise — check the flight-recorder dump and the device link",
+    ])
+
+
+def _check_compile_storm(records, phase: str,
+                         wall: float) -> Optional[Dict[str, Any]]:
+    compiles = [r for r in records if r.get("event") == "compile"]
+    total = sum(float(r.get("duration_s", 0.0)) for r in compiles)
+    if (len(compiles) >= COMPILE_STORM_MIN_EVENTS and wall > 0
+            and total > COMPILE_STORM_WALL_FRAC * wall):
+        return _verdict(phase, "COMPILE_STORM", [
+            f"{len(compiles)} compilations totaling {total:.1f}s = "
+            f"{total / wall:.0%} of the {wall:.1f}s wall clock",
+            "look for shape churn (bucket spread, microbatch breaks) or "
+            "a cold/invalidated compilation cache",
+        ])
+    return None
+
+
+def _diagnose_train(records) -> Optional[Dict[str, Any]]:
+    # step records in a serving log are the loadtest's per-request
+    # accounting (serve/loadtest.py), not a train loop — the request
+    # records carry that story; steps with in_flight are eval frames
+    if any(r.get("event") == "request" for r in records):
+        return None
+    steps = [r for r in records
+             if r.get("event") == "step" and "in_flight" not in r]
+    if not steps:
+        return None
+    phase = "train"
+    hit = _check_stall(records, phase)
+    if hit:
+        return hit
+    wall = _wall_s(records)
+    hit = _check_compile_storm(records, phase, wall)
+    if hit:
+        return hit
+    # skip the first step: its dispatch leg carries compilation
+    body = steps[1:] or steps
+    waits = [float(r.get("data_wait_s", 0.0)) for r in body]
+    disps = [float(r.get("dispatch_s", 0.0)) for r in body]
+    fetches = [float(r.get("fetch_s", 0.0)) for r in body]
+    totals = [w + d + f for w, d, f in zip(waits, disps, fetches)]
+    med_total = _median(totals)
+    if med_total <= 0:
+        return _verdict(phase, "UNKNOWN",
+                        ["step records carry no usable phase timing"])
+    wait_frac = _median(waits) / med_total
+    dev_frac = _median([d + f for d, f in zip(disps, fetches)]) / med_total
+    if wait_frac > DATA_STARVED_FRAC:
+        evidence = [
+            f"median step: data_wait {_median(waits) * 1e3:.1f}ms of "
+            f"{med_total * 1e3:.1f}ms ({wait_frac:.0%}) over "
+            f"{len(body)} steps"]
+        loaders = [r for r in records if r.get("event") == "loader"]
+        if loaders:
+            depths = [float(r.get("queue_depth", 0)) for r in loaders]
+            evidence.append(
+                f"loader prefetch queue depth: median {_median(depths):.0f}"
+                f" (0 = producer cannot keep up)")
+        evidence.append("raise decode workers / prefetch before touching "
+                        "the model")
+        return _verdict(phase, "DATA_STARVED", evidence)
+    if dev_frac >= COMPUTE_BOUND_FRAC:
+        return _verdict(phase, "COMPUTE_BOUND", [
+            f"median step: dispatch+fetch "
+            f"{_median([d + f for d, f in zip(disps, fetches)]) * 1e3:.1f}"
+            f"ms of {med_total * 1e3:.1f}ms ({dev_frac:.0%}) over "
+            f"{len(body)} steps",
+            "the pipeline keeps the device fed; wins come from the "
+            "model/compiler (serial-floor work, ROADMAP item 1)",
+        ])
+    return _verdict(phase, "BALANCED", [
+        f"median step {med_total * 1e3:.1f}ms: data_wait {wait_frac:.0%}, "
+        f"device {dev_frac:.0%} — no phase dominates",
+    ])
+
+
+def _diagnose_serve(records) -> Optional[Dict[str, Any]]:
+    requests = [r for r in records if r.get("event") == "request"]
+    if not requests:
+        return None
+    phase = "serve"
+    hit = _check_stall(records, phase)
+    if hit:
+        return hit
+    hit = _check_compile_storm(records, phase, _wall_s(records))
+    if hit:
+        return hit
+    lats = [float(r.get("latency_s", 0.0)) for r in requests]
+    waits = [float(r.get("queue_wait_s", 0.0)) for r in requests]
+    med_lat = _median(lats)
+    if med_lat <= 0:
+        return _verdict(phase, "UNKNOWN",
+                        ["request records carry no usable latency"])
+    wait_frac = _median(waits) / med_lat
+    rejected = 0
+    for r in records:
+        if r.get("event") in ("queue", "slo"):
+            rejected = max(rejected, int(r.get("rejected", 0)))
+    if wait_frac > QUEUE_SATURATED_FRAC:
+        evidence = [
+            f"median request: queue_wait {_median(waits) * 1e3:.1f}ms of "
+            f"{med_lat * 1e3:.1f}ms latency ({wait_frac:.0%}) over "
+            f"{len(requests)} requests"]
+        if rejected:
+            evidence.append(f"{rejected} submits shed by backpressure — "
+                            f"offered load exceeds service rate")
+        depths = [int(r.get("depth", 0)) for r in records
+                  if r.get("event") == "queue"]
+        if depths:
+            evidence.append(f"admission queue depth: median "
+                            f"{_median([float(d) for d in depths]):.0f}, "
+                            f"max {max(depths)}")
+        evidence.append("scale out, raise max_batch/window, or shed "
+                        "earlier")
+        return _verdict(phase, "QUEUE_SATURATED", evidence)
+    return _verdict(phase, "COMPUTE_BOUND", [
+        f"median request: queue_wait {wait_frac:.0%} of "
+        f"{med_lat * 1e3:.1f}ms latency over {len(requests)} requests — "
+        f"time goes to the device, not the queue",
+        "bigger wins come from the compiled program (bucket/batch "
+        "shape), not admission tuning",
+    ])
+
+
+def diagnose(run_dir: str) -> Dict[str, Any]:
+    """Diagnose one run dir; returns ``{"run_dir", "verdicts": [...]}``.
+
+    ``verdicts`` holds one entry per phase with evidence; a log with
+    neither steps nor requests yields a single UNKNOWN verdict.
+    """
+    events_path = (os.path.join(run_dir, "events.jsonl")
+                   if os.path.isdir(run_dir) else run_dir)
+    records = read_events(events_path)
+    verdicts = [v for v in (_diagnose_train(records),
+                            _diagnose_serve(records)) if v is not None]
+    if not verdicts:
+        verdicts = [_verdict("run", "UNKNOWN", [
+            "no step or request records — nothing to diagnose"])]
+    return {"run_dir": run_dir, "verdicts": verdicts}
+
+
+def format_diagnosis(report: Dict[str, Any]) -> str:
+    lines = [f"doctor: {report['run_dir']}"]
+    for v in report["verdicts"]:
+        lines.append(f"  [{v['phase']}] {v['verdict']}")
+        for e in v["evidence"]:
+            lines.append(f"    - {e}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from raft_stereo_tpu.cli import build_doctor_parser
+    args = build_doctor_parser().parse_args(argv)
+    try:
+        report = diagnose(args.run_dir)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"doctor: {e}")
+        return 1
+    if getattr(args, "json"):
+        import json
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_diagnosis(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

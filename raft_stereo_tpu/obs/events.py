@@ -27,18 +27,19 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # Back-compat: every schema version whose artifacts are still readable.
 # v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
 # op_counts jaxpr profile event), v3 -> v4 (the graftlint `lint` report
 # event), v4 -> v5 (the fault-tolerance events: preempt/resume/
-# ckpt_integrity/anomaly) and v5 -> v6 (the serving events: request/queue/
-# slo) were purely ADDITIVE — no earlier event changed its required
-# fields — so pre-existing runs/*/events.jsonl lint clean: an older record
-# is validated against its own surface (it just may not use events
+# ckpt_integrity/anomaly), v5 -> v6 (the serving events: request/queue/
+# slo) and v6 -> v7 (the tracing events: span/flightrec) were purely
+# ADDITIVE — no earlier event changed its required fields — so
+# pre-existing runs/*/events.jsonl lint clean: an older record is
+# validated against its own surface (it just may not use events
 # introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -54,6 +55,8 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "request": 6,
     "queue": 6,
     "slo": 6,
+    "span": 7,
+    "flightrec": 7,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -126,6 +129,20 @@ EVENT_TYPES: Dict[str, tuple] = {
     "request": ("id", "status"),
     "queue": ("depth",),
     "slo": ("p50_ms", "p99_ms", "pairs_per_sec", "in_flight"),
+    # Tracing (obs/trace.py, schema v7). `span`: one closed span of the
+    # unified host timeline — `trace_id` groups the spans of one unit of
+    # work (a train step, a served request), `span_id` is unique within
+    # the run, `parent_id` (optional) nests it under another span of the
+    # same file (referential integrity is linted by obs/validate.py), and
+    # `start_s`/`dur_s` sit on the same monotonic `t` axis every other
+    # record uses, so `cli timeline` can interleave spans with events and
+    # the jax.profiler device trace on one clock. `thread` and arbitrary
+    # attrs ride along. `flightrec`: a flight-recorder dump happened —
+    # `reason` is what fired it (stall/anomaly/crash/preempt/drain),
+    # `path` the dumped ``flightrec-<ts>.jsonl`` carrying the in-memory
+    # event/span rings at full resolution.
+    "span": ("name", "span_id", "trace_id", "start_s", "dur_s"),
+    "flightrec": ("reason", "path"),
     "run_end": ("steps",),
 }
 

@@ -83,6 +83,43 @@ def _pipeline_overlap(steps: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
             "efficiency": round(serial / wall, 3)}
 
 
+def _span_summary(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Phase percentiles + overlap efficiency from schema-v7 spans.
+
+    The span-derived twin of :func:`_pipeline_overlap`/the step-phase
+    table: per-name duration percentiles, root child-coverage, and —
+    because child spans are the serial phase work while wall time spans
+    first start to last end — a pipeline-overlap efficiency that needs no
+    ``jax.profiler`` capture. This is what lets ``cli telemetry`` say
+    something better than "trace: none" on span-carrying runs.
+    """
+    spans = [e for e in events if e.get("event") == "span"]
+    if not spans:
+        return None
+    from raft_stereo_tpu.obs.timeline import span_coverage
+    by_name: Dict[str, List[float]] = {}
+    for s in spans:
+        by_name.setdefault(str(s.get("name", "?")), []).append(
+            float(s.get("dur_s", 0.0)))
+    starts = [float(s.get("start_s", 0.0)) for s in spans]
+    ends = [float(s.get("start_s", 0.0)) + float(s.get("dur_s", 0.0))
+            for s in spans]
+    wall = max(ends) - min(starts)
+    serial = sum(float(s.get("dur_s", 0.0)) for s in spans
+                 if s.get("parent_id") is not None)
+    out: Dict[str, Any] = {
+        "count": len(spans),
+        "by_name": {n: {"count": len(v), **_percentiles(v)}
+                    for n, v in sorted(by_name.items())},
+        "coverage": span_coverage(spans),
+    }
+    if wall > 0 and serial > 0:
+        out["overlap"] = {"serial_s": round(serial, 4),
+                          "wall_s": round(wall, 4),
+                          "efficiency": round(serial / wall, 3)}
+    return out
+
+
 def _pipeline_gauges(events: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     import numpy as np
     gauges = [e for e in events if e.get("event") == "pipeline"]
@@ -169,6 +206,7 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                    for p in _PHASES if any(p in s for s in steps)},
         "throughput_trend": _throughput_trend(steps),
         "pipeline_overlap": _pipeline_overlap(steps),
+        "spans": _span_summary(events),
         "pipeline": _pipeline_gauges(events),
         "xla": _xla_summary(events),
         "compiles": {
@@ -256,6 +294,20 @@ def format_summary(report: Dict[str, Any]) -> str:
             lines.append(f"pipeline overlap: {ov['efficiency']}x "
                          f"({ov['serial_s']}s of phase work in "
                          f"{ov['wall_s']}s wall)")
+        sp = ev.get("spans")
+        if sp:
+            lines.append("")
+            lines.append(f"spans: {sp['count']}"
+                         + (f", root child-coverage min "
+                            f"{sp['coverage']['min']:.0%} mean "
+                            f"{sp['coverage']['mean']:.0%}"
+                            if sp["coverage"].get("roots") else ""))
+            lines.append("span phases (s):   count"
+                         "       p50       p90       max     total")
+            for name, q in sp["by_name"].items():
+                lines.append(f"  {name:16s} {q['count']:5d} "
+                             f"{q['p50']:9.4f} {q['p90']:9.4f} "
+                             f"{q['max']:9.4f} {q['total']:9.2f}")
         pg = ev.get("pipeline")
         if pg:
             depth = (f"in-flight p50 {pg['in_flight_p50']} "
@@ -330,7 +382,16 @@ def format_summary(report: Dict[str, Any]) -> str:
     tr = report.get("trace")
     lines.append("")
     if tr is None:
-        lines.append("trace: none (no jax.profiler capture under the run dir)")
+        sp = (ev or {}).get("spans") if ev else None
+        if sp and sp.get("overlap"):
+            o = sp["overlap"]
+            lines.append(
+                f"trace: no jax.profiler capture; span-derived pipeline "
+                f"efficiency {o['efficiency']}x ({o['serial_s']}s of span "
+                f"work in {o['wall_s']}s wall)")
+        else:
+            lines.append(
+                "trace: none (no jax.profiler capture under the run dir)")
     elif "error" in tr:
         lines.append(f"trace: unreadable ({tr['error']})")
     else:

@@ -24,15 +24,26 @@ Three observers ride on the bus:
 * **Device memory** — ``memory`` records via
   ``jax.local_devices()[0].memory_stats()`` where the backend provides it
   (TPU does; CPU returns nothing and the record carries ``stats: {}``).
+* **Flight recorder** — the last N records (and, when a tracer is
+  attached, its span ring) are mirrored in memory and dumped to
+  ``<run_dir>/flightrec-<ts>.jsonl`` when something goes wrong: the stall
+  watchdog firing, an ``anomaly``/``preempt`` record landing, the crash
+  path (:meth:`error`), or an explicit drain. Postmortems then carry the
+  last seconds at full resolution even when steady-state sampling is
+  coarse; each dump leaves a ``flightrec`` record on the bus pointing at
+  the side file. Rate-limited per reason so a flapping watchdog cannot
+  fill the disk.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Dict, Optional
 
 from raft_stereo_tpu.obs.events import make_record, append_json_log
@@ -42,6 +53,12 @@ logger = logging.getLogger(__name__)
 # Compile-episode deadline widening before the first heartbeat (see module
 # doc); tests override via the Telemetry(first_step_grace=) knob.
 _FIRST_STEP_GRACE = 10.0
+
+# Flight-recorder knobs: recent-record ring capacity, and the per-reason
+# dump rate limit (a wedged run re-fires the watchdog every interval;
+# one dump per episode is the useful one).
+_FLIGHT_RING = 256
+_FLIGHT_MIN_INTERVAL_S = 30.0
 
 # --- process-global compile-hook dispatch ----------------------------------
 _hook_lock = threading.Lock()
@@ -83,7 +100,8 @@ class Telemetry:
     def __init__(self, run_dir: str, run_name: Optional[str] = None,
                  stall_deadline_s: Optional[float] = None,
                  first_step_grace: float = _FIRST_STEP_GRACE,
-                 watch_interval_s: Optional[float] = None):
+                 watch_interval_s: Optional[float] = None,
+                 flightrec_min_interval_s: float = _FLIGHT_MIN_INTERVAL_S):
         self.run_dir = run_dir
         self.run_name = run_name or os.path.basename(
             os.path.normpath(run_dir)) or "run"
@@ -104,6 +122,11 @@ class Telemetry:
         self._stalled = False
         self._stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        # flight recorder: recent-record mirror + attached tracer
+        self.tracer = None
+        self._recent: "deque" = deque(maxlen=_FLIGHT_RING)
+        self._flight_min_interval = flightrec_min_interval_s
+        self._flight_last: Dict[str, float] = {}
         os.makedirs(run_dir, exist_ok=True)
         _active_instances.add(self)
         _ensure_compile_hook()
@@ -125,12 +148,84 @@ class Telemetry:
                 if self._closed:
                     return
                 append_json_log(self.events_path, rec, stream=None)
+                if event != "span":  # span rings live in the tracer
+                    self._recent.append(rec)
         except Exception:
             if not self._emit_failed:
                 self._emit_failed = True
                 logger.exception("telemetry emit failed (disabled for run)")
+            return
+        # Trigger OUTSIDE the lock: flight_dump re-enters emit (for the
+        # flightrec record) and snapshots the tracer under its own lock.
+        if event in ("anomaly", "preempt"):
+            self.flight_dump(event)
+
+    def attach_tracer(self, tracer) -> None:
+        """Bind a Tracer (obs/trace.py): its span flushes already ride this
+        bus via :meth:`emit`; binding also puts its ring into flight dumps
+        and has close/``__exit__`` flush it before ``run_end``."""
+        self.tracer = tracer
+
+    def flight_dump(self, reason: str) -> Optional[str]:
+        """Dump the in-memory rings to ``<run_dir>/flightrec-<ts>.jsonl``.
+
+        First line is a header (reason, counts); then the recent records
+        (``kind: event``) and the tracer's span ring including still-open
+        spans (``kind: span``), each with its payload nested under
+        ``record`` so payload fields can never clobber the envelope. A
+        ``flightrec`` record lands on the bus
+        pointing at the file. Returns the path, or None when rate-limited,
+        closed, or the dump failed (fail-open like everything here).
+        """
+        now = time.monotonic()
+        with self._lock:
+            if self._closed:
+                return None
+            last = self._flight_last.get(reason)
+            if last is not None and (
+                    now - last < self._flight_min_interval):
+                return None
+            self._flight_last[reason] = now
+            events = list(self._recent)
+        tracer = self.tracer
+        spans = tracer.snapshot() if tracer is not None else []
+        ts = time.strftime("%Y%m%dT%H%M%S")
+        path = os.path.join(self.run_dir, f"flightrec-{ts}.jsonl")
+        n = 1
+        while os.path.exists(path):  # two dumps in one second
+            path = os.path.join(
+                self.run_dir, f"flightrec-{ts}-{n}.jsonl")
+            n += 1
+        try:
+            with open(path, "w") as f:
+                f.write(json.dumps({
+                    "kind": "flightrec", "reason": reason,
+                    "run": self.run_name, "t": round(now - self._t0, 6),
+                    "events": len(events), "spans": len(spans)}) + "\n")
+                # the payload rides nested: records have their own `kind`
+                # fields (anomaly), which must not clobber the envelope
+                for rec in events:
+                    f.write(json.dumps(
+                        {"kind": "event", "record": rec}) + "\n")
+                for sp in spans:
+                    f.write(json.dumps(
+                        {"kind": "span", "record": sp}) + "\n")
+        except Exception:
+            logger.exception("flight-recorder dump failed")
+            return None
+        self.emit("flightrec", reason=reason, path=path,
+                  events=len(events), spans=len(spans))
+        logger.warning("flight recorder (%s): %d events + %d spans -> %s",
+                       reason, len(events), len(spans), path)
+        return path
 
     def close(self) -> None:
+        tracer = self.tracer
+        if tracer is not None:  # salvage buffered spans (idempotent)
+            try:
+                tracer.close()
+            except Exception:
+                logger.exception("tracer close failed")
         self._stop.set()
         if self._watchdog is not None:
             self._watchdog.join(timeout=2.0)
@@ -144,6 +239,11 @@ class Telemetry:
     def __exit__(self, exc_type, exc, tb) -> None:
         if exc is not None:
             self.error(exc)
+        if self.tracer is not None:  # no span may land after run_end
+            try:
+                self.tracer.close()
+            except Exception:
+                logger.exception("tracer close failed")
         self.emit("run_end", steps=self._steps,
                   ok=exc is None, compile_s=round(self._compile_s, 3))
         self.close()
@@ -227,6 +327,7 @@ class Telemetry:
         self.emit("error", error=f"{type(exc).__name__}: {exc}",
                   traceback="".join(traceback.format_exception(
                       type(exc), exc, exc.__traceback__))[-4000:])
+        self.flight_dump("crash")
 
     def _emit_compile(self, source: str, duration: float) -> None:
         self._compile_s += duration
@@ -251,6 +352,7 @@ class Telemetry:
                     self.events_path)
                 self.emit("stall", seconds_since_step=round(elapsed, 3),
                           deadline_s=deadline, steps=self._steps)
+                self.flight_dump("stall")
 
 
 def _device_info() -> Dict[str, Any]:

@@ -18,6 +18,37 @@ from typing import Iterable, List, Optional, Sequence
 from raft_stereo_tpu.obs.events import read_events, validate_events
 
 
+def check_span_integrity(records: Iterable[dict]) -> List[str]:
+    """Referential integrity of schema-v7 ``span`` records within one file.
+
+    A tracer flush may interleave traces, but by end-of-file every
+    ``parent_id`` must resolve to a flushed ``span_id`` (obs/trace.py's
+    ``close()`` guarantees this by force-flushing open spans) and span ids
+    must be unique — an orphan parent or a duplicate id means a writer
+    dropped or double-emitted part of a trace.
+    """
+    spans = [r for r in records
+             if isinstance(r, dict) and r.get("event") == "span"]
+    errors: List[str] = []
+    seen: set = set()
+    for s in spans:
+        sid = s.get("span_id")
+        if sid in seen:
+            errors.append(f"span: duplicate span_id {sid!r}")
+        seen.add(sid)
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent is not None and parent not in seen:
+            errors.append(
+                f"span {s.get('span_id')!r} ({s.get('name')!r}): orphan "
+                f"parent_id {parent!r} — no such span in this file")
+        trace = s.get("trace_id")
+        if not isinstance(trace, str) or not trace:
+            errors.append(
+                f"span {s.get('span_id')!r}: missing/empty trace_id")
+    return errors
+
+
 def check_path(path: str) -> List[str]:
     """Validate one ``events.jsonl`` (or a run directory containing one).
 
@@ -35,7 +66,9 @@ def check_path(path: str) -> List[str]:
         return [str(e)]
     if not records:
         return [f"{path}: empty event log"]
-    return [f"{path}: {e}" for e in validate_events(records)]
+    errors = validate_events(records)
+    errors.extend(check_span_integrity(records))
+    return [f"{path}: {e}" for e in errors]
 
 
 def check_paths(paths: Iterable[str]) -> List[str]:

@@ -14,6 +14,10 @@ while the scheduler batches across connections. Endpoints:
 * ``GET /healthz`` — scheduler liveness + counters (JSON); 503 once
   draining, so load balancers stop routing here during shutdown.
 * ``GET /slo`` — the SLOTracker rollup (p50/p99/pairs_per_sec) as JSON.
+* ``GET /metrics`` — the same rollup in Prometheus text format
+  (``raft_serve_*`` gauges/counters) so external scrapers don't have to
+  poll and re-shape the JSON; disable with ``make_http_server(...,
+  metrics=False)`` / ``cli serve --no_metrics``.
 
 SIGTERM/SIGINT → graceful drain via training/resilience.SignalGuard:
 stop admitting, finish every admitted request, exit 0. SIGHUP → hot
@@ -43,10 +47,60 @@ def _json_bytes(payload) -> bytes:
     return (json.dumps(payload, sort_keys=True) + "\n").encode()
 
 
+# stats() key -> (prometheus metric name, type). Counters are monotone
+# process-lifetime totals (SLOTracker counters); everything else is a
+# point-in-time gauge.
+_PROM_METRICS = (
+    ("p50_ms", "raft_serve_latency_p50_ms", "gauge",
+     "Rolling-window p50 end-to-end latency (ms)"),
+    ("p99_ms", "raft_serve_latency_p99_ms", "gauge",
+     "Rolling-window p99 end-to-end latency (ms)"),
+    ("pairs_per_sec", "raft_serve_pairs_per_sec", "gauge",
+     "Sustained throughput over the SLO sample window"),
+    ("in_flight", "raft_serve_in_flight", "gauge",
+     "Device dispatches currently in flight"),
+    ("queue_depth", "raft_serve_queue_depth", "gauge",
+     "Requests admitted but not yet collected into a batch"),
+    ("window_requests", "raft_serve_window_requests", "gauge",
+     "Retirements inside the current SLO sample window"),
+    ("draining", "raft_serve_draining", "gauge",
+     "1 once admission closed for shutdown"),
+    ("executables", "raft_serve_executables", "gauge",
+     "Compiled bucket programs resident in the cache"),
+    ("sessions", "raft_serve_sessions", "gauge",
+     "Live warm-start video sessions"),
+    ("admitted", "raft_serve_requests_admitted_total", "counter",
+     "Requests admitted past the bounded queue"),
+    ("completed", "raft_serve_requests_completed_total", "counter",
+     "Requests retired ok"),
+    ("failed", "raft_serve_requests_failed_total", "counter",
+     "Requests retired as errors (poisoned output / dispatch failure)"),
+    ("rejected", "raft_serve_requests_rejected_total", "counter",
+     "Submits shed by backpressure or drain"),
+)
+
+
+def prometheus_metrics(stats: dict) -> str:
+    """Render a ``stats()`` dict as Prometheus text exposition format."""
+    lines = []
+    for key, name, kind, help_text in _PROM_METRICS:
+        if key not in stats:
+            continue
+        value = stats[key]
+        if isinstance(value, bool):
+            value = int(value)
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {float(value):g}")
+    return "\n".join(lines) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "raft-stereo-serve/1.0"
     #: set by make_http_server
     stereo: StereoServer = None  # type: ignore[assignment]
+    #: /metrics exposition toggle (make_http_server(metrics=...))
+    metrics: bool = True
 
     def log_message(self, fmt, *args):  # route to logging, not stderr
         logger.debug("http: " + fmt, *args)
@@ -69,6 +123,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(code, _json_bytes(stats))
         elif path == "/slo":
             self._reply(200, _json_bytes(self.stereo.stats()))
+        elif path == "/metrics" and self.metrics:
+            self._reply(200, prometheus_metrics(self.stereo.stats()).encode(),
+                        ctype="text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply(404, _json_bytes({"error": "not found"}))
 
@@ -121,9 +178,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_http_server(stereo: StereoServer, host: str = "127.0.0.1",
-                     port: int = 8600) -> ThreadingHTTPServer:
+                     port: int = 8600,
+                     metrics: bool = True) -> ThreadingHTTPServer:
     """Bind (but do not serve) the HTTP front; caller owns serve/shutdown."""
-    handler = type("BoundHandler", (_Handler,), {"stereo": stereo})
+    handler = type("BoundHandler", (_Handler,),
+                   {"stereo": stereo, "metrics": metrics})
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
     return httpd

@@ -57,6 +57,7 @@ import numpy as np
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.inference import PAD_DIVIS, bucket_size
+from raft_stereo_tpu.obs.trace import NULL_TRACER
 from raft_stereo_tpu.ops.geometry import InputPadder
 from raft_stereo_tpu.serve.batching import (BoundedQueue, QueueClosed,
                                             collect_group)
@@ -158,6 +159,11 @@ class _Request:
     t_submit: float
     handle: ResultHandle
     t_dispatch: float = 0.0
+    # lifecycle stamps for the request's span tree (queue_wait ends when
+    # the scheduler pulls the request; dispatch ends when the device call
+    # returns its handles)
+    t_collect: float = 0.0
+    t_disp_end: float = 0.0
 
 
 class StereoServer:
@@ -334,9 +340,13 @@ class StereoServer:
         return (bh, bw, req.iters, req.warm)
 
     def _collect(self, first: _Request) -> List[_Request]:
+        first.t_collect = first.t_collect or time.perf_counter()
         group = collect_group(
             first, self._queue.get_nowait, self._queue.push_front,
             self.serve.max_batch, key=self._group_key)
+        tc = time.perf_counter()
+        for req in group:
+            req.t_collect = req.t_collect or tc
         deadline = time.perf_counter() + self.serve.linger_s
         k0 = self._group_key(first)
         while (len(group) < self.serve.max_batch
@@ -350,6 +360,7 @@ class StereoServer:
             if self._group_key(item) != k0:
                 self._queue.push_front(item)
                 break
+            item.t_collect = item.t_collect or time.perf_counter()
             group.append(item)
         return group
 
@@ -388,6 +399,9 @@ class StereoServer:
         except Exception as exc:  # compile/shape failure: fail this batch
             self._fail_group(group, key, exc, kind="dispatch")
             return
+        t1 = time.perf_counter()
+        for req in group:
+            req.t_disp_end = t1
         self._in_flight.append((group, padders, key, outputs))
 
     def _retire(self) -> None:
@@ -452,6 +466,23 @@ class StereoServer:
             bucket=result.bucket, batch_size=result.batch_size,
             in_flight=len(self._in_flight), stream=req.stream,
             error=result.error, traceback_tail=result.traceback)
+        # the request's span tree, from the lifecycle stamps already taken:
+        # queue_wait / collect_group / dispatch / retire tile the root
+        # exactly (end = submit + the latency the client was told)
+        tracer = getattr(self.telemetry, "tracer", None) or NULL_TRACER
+        if tracer.enabled:
+            end = req.t_submit + result.latency_s
+            tc = req.t_collect or req.t_dispatch or end
+            td = req.t_dispatch or tc
+            te = req.t_disp_end or td
+            root = tracer.record(
+                "request", req.t_submit, end, id=req.id,
+                status="ok" if result.ok else "error",
+                bucket=result.bucket, batch_size=result.batch_size)
+            tracer.record("queue_wait", req.t_submit, tc, parent=root)
+            tracer.record("collect_group", tc, td, parent=root)
+            tracer.record("dispatch", td, te, parent=root)
+            tracer.record("retire", te, end, parent=root)
 
     def _run(self) -> None:
         try:
@@ -471,6 +502,14 @@ class StereoServer:
                 self._retire()
             self.slo.flush(in_flight=0)
         finally:
+            # drain: flush buffered spans and bank a flight-recorder dump
+            # so a post-drain postmortem has the tail of the run
+            tracer = getattr(self.telemetry, "tracer", None)
+            if tracer is not None:
+                tracer.flush()
+            flight = getattr(self.telemetry, "flight_dump", None)
+            if flight is not None and self._draining:
+                flight("drain")
             self._stopped.set()
             logger.info("serve: scheduler stopped (%s)",
                         "drained" if self._draining else "exited")

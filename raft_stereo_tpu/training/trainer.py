@@ -52,6 +52,7 @@ from raft_stereo_tpu.data.datasets import fetch_dataloader
 from raft_stereo_tpu.data.loader import infinite_batches
 from raft_stereo_tpu.models import init_model
 from raft_stereo_tpu.obs import Telemetry
+from raft_stereo_tpu.obs.trace import tracer_for
 from raft_stereo_tpu.parallel.data_parallel import make_pjit_train_step
 from raft_stereo_tpu.parallel.mesh import make_mesh, replicated, shard_batch
 from raft_stereo_tpu.training import resilience
@@ -208,9 +209,14 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
         tel.emit("ckpt_integrity", **report)
     if resume_from is not None:
         tel.emit("resume", step=int(state.step), path=resume_from)
+    # span tracing (obs/trace.py): the step loop's existing perf_counter
+    # stamps become step/data_wait/dispatch/fetch spans; cfg.trace=False
+    # yields the null tracer and an events.jsonl with no span records.
+    tracer = tracer_for(tel, enabled=cfg.trace)
     loader.gauge_hook = tel.loader_gauge
     loader.quarantine_hook = lambda info: tel.emit(
         "anomaly", kind="loader_quarantine", **info)
+    loader.tracer = tracer
     policy = resilience.AnomalyPolicy(
         cfg.anomaly_max_skips if cfg.anomaly_guard else 0, telemetry=tel)
     nan_step = resilience.injected_nan_step()
@@ -276,6 +282,14 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                                {"data_wait_s": t1 - t0,
                                 "dispatch_s": t2 - t1,
                                 "fetch_s": t3 - t2})
+                    # retroactive spans from the stamps just taken: the
+                    # t0..t3 legs tile the step root exactly (100% child
+                    # coverage for cli timeline / cli doctor)
+                    root = tracer.record("step", t0, t3,
+                                         step=global_step + 1)
+                    tracer.record("data_wait", t0, t1, parent=root)
+                    tracer.record("dispatch", t1, t2, parent=root)
+                    tracer.record("fetch", t2, t3, parent=root)
                     global_step += 1
                     if global_step == start_step + 1:
                         # first-call latency: the pjit dispatch above compiled
@@ -340,9 +354,10 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                         config_digest=run_digest, reason="final")
                     tel.checkpoint(global_step, final, reason="final")
             except BaseException as e:
-                tel.error(e)
+                tel.error(e)  # also fires the flight recorder ("crash")
                 _emergency_checkpoint(e, state, cfg, tel, global_step,
                                       run_digest)
+                tracer.close()  # flush spans before run_end
                 tel.emit("run_end", steps=global_step - start_step,
                          ok=False, step=global_step)
                 tel.close()
@@ -350,6 +365,7 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
             finally:
                 log.close()
     tel.window_throughput()
+    tracer.close()  # flush spans before run_end
     tel.emit("run_end", steps=global_step - start_step, ok=True,
              step=global_step,
              **({"reason": "preempt"} if preempted else {}))

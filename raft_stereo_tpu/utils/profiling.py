@@ -49,22 +49,29 @@ def _latest_trace_json(log_dir: str) -> Optional[str]:
     return paths[-1] if paths else None
 
 
-def summarize_trace(log_dir: str, top: int = 25) -> Dict[str, Any]:
-    """Aggregate device-op time from the latest trace under ``log_dir``.
+def load_trace_events(log_dir: str) -> tuple:
+    """Load the latest Chrome-trace capture under ``log_dir``.
 
-    Returns ``{"trace": path, "total_device_ms": t, "by_category": [...],
-    "top_ops": [...]}`` where times are totals over the captured region
-    (divide by your step count for per-step numbers). Categories come from XLA
-    (``convolution fusion``, ``loop fusion``, ...); ``top_ops`` carries each
-    op's HLO ``long_name`` prefix so shapes are visible.
+    Returns ``(path, events)`` — the ``traceEvents`` list of the newest
+    ``plugins/profile/*/*.trace.json.gz``. Shared by :func:`summarize_trace`
+    and the host/device timeline merger (obs/timeline.py). Raises
+    FileNotFoundError when no capture exists.
     """
     path = _latest_trace_json(log_dir)
     if path is None:
         raise FileNotFoundError(f"no trace.json.gz under {log_dir}")
     data = json.load(gzip.open(path, "rt"))
-    events = data.get("traceEvents", [])
+    return path, data.get("traceEvents", [])
 
-    # device process ids ("/device:TPU:0" etc.); tid 3 = "XLA Ops" lane
+
+def device_lanes(events) -> tuple:
+    """Identify the device lanes of a Chrome-trace event list.
+
+    Returns ``(device_pids, op_tids)``: process ids whose metadata name
+    mentions ``/device:`` ("/device:TPU:0" etc.) and their "XLA Ops"
+    ``(pid, tid)`` lanes — the per-op device timeline. Host-only captures
+    (CPU backend) return two empty sets.
+    """
     device_pids = set()
     op_tids = set()
     for e in events:
@@ -74,6 +81,20 @@ def summarize_trace(log_dir: str, top: int = 25) -> Dict[str, Any]:
         if e.get("ph") == "M" and e.get("name") == "thread_name":
             if e.get("args", {}).get("name") == "XLA Ops":
                 op_tids.add((e["pid"], e["tid"]))
+    return device_pids, op_tids
+
+
+def summarize_trace(log_dir: str, top: int = 25) -> Dict[str, Any]:
+    """Aggregate device-op time from the latest trace under ``log_dir``.
+
+    Returns ``{"trace": path, "total_device_ms": t, "by_category": [...],
+    "top_ops": [...]}`` where times are totals over the captured region
+    (divide by your step count for per-step numbers). Categories come from XLA
+    (``convolution fusion``, ``loop fusion``, ...); ``top_ops`` carries each
+    op's HLO ``long_name`` prefix so shapes are visible.
+    """
+    path, events = load_trace_events(log_dir)
+    device_pids, op_tids = device_lanes(events)
 
     cat_time: collections.Counter = collections.Counter()
     op_time: collections.Counter = collections.Counter()

@@ -9,12 +9,17 @@ violation. The validation logic lives in
 ``events`` leg and the graftlint test fixtures — so the CLI and the
 library can never drift apart.
 
-Back-compat: v1 -> v2 -> v3 -> v4 -> v5 were additive (obs/events.py
+Back-compat: v1 -> ... -> v7 were additive (obs/events.py
 ``SUPPORTED_SCHEMA_VERSIONS``), so pre-existing artifacts lint clean; the
 v4 addition is the ``lint`` static-analysis report event
 (raft_stereo_tpu/analysis), the v5 additions are the fault-tolerance
 events — preempt/resume/ckpt_integrity/anomaly
-(raft_stereo_tpu/training/resilience.py).
+(raft_stereo_tpu/training/resilience.py), v6 the serving events, and v7
+the tracing events — ``span`` (obs/trace.py) and ``flightrec`` (the
+telemetry flight recorder). For v7 files the lint additionally checks
+span referential integrity (obs/validate.py ``check_span_integrity``):
+unique span_ids, parent_ids resolving within the file, non-empty
+trace_ids.
 
 Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
 """

@@ -64,6 +64,12 @@ rehearsal:
   requests, and ``cli compare`` must arbitrate served-vs-sequential
   throughput from the phase's telemetry. The full >=3-bucket/8-client
   acceptance record is banked separately in runs/load_drill/.
+* **trace** — the tracing rehearsal (r13): ``python
+  scripts/trace_drill.py`` — a tiny CPU train and a tiny loadtest must
+  each yield ``cli timeline`` exit 0 with >= 90% of every step's/
+  request's wall time covered by named child spans, and ``cli doctor``
+  exit 0 with a non-UNKNOWN verdict. The span instrumentation earns its
+  keep on real runs, not just in tests/test_trace.py.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
@@ -209,15 +215,16 @@ def main(argv=None):
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve"],
+                            "serve", "trace"],
                    choices=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve"])
+                            "serve", "trace"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
     p.add_argument("--fault-budget", type=float, default=1800.0)
     p.add_argument("--serve-budget", type=float, default=1800.0)
+    p.add_argument("--trace-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -280,6 +287,12 @@ def main(argv=None):
              "--small", "--shapes", "48x96", "64x128",
              "--clients", "4", "--requests", "3"],
             args.serve_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "trace" in args.legs:
+        records.append(run_leg(
+            "trace",
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "trace_drill.py")],
+            args.trace_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Tracing rehearsal: prove `cli timeline` + `cli doctor` on real runs.
+
+The span-tracing acceptance bar (r13) is not "the unit tests pass" — it
+is that the artifacts a real run leaves behind support the workflow:
+
+1. **train leg** — a tiny CPU training run (synthetic FlyingThings tree,
+   the fault_drill fixture) with tracing on (the default). Its run dir
+   must yield: `cli timeline` exit 0 with >= 90% of each step's wall
+   time covered by named child spans, and `cli doctor` exit 0 with a
+   non-UNKNOWN train verdict.
+2. **serve leg** — a tiny `cli loadtest` (no baseline phase). The serve
+   run dir must yield the same: timeline exit 0 with >= 90% request
+   child coverage, doctor exit 0 with a non-UNKNOWN serve verdict.
+
+Each leg appends a dated JSON record to ``runs/trace_drill/drills.jsonl``;
+exit non-zero if any check failed. Driven by scripts/rehearse_round.py's
+``trace`` leg.
+
+Run: JAX_PLATFORMS=cpu python scripts/trace_drill.py [--keep-work]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT = os.path.join(REPO, "runs", "trace_drill")
+LOG = os.path.join(OUT, "drills.jsonl")
+
+COVERAGE_MIN = 0.9
+CHILD_TIMEOUT_S = 900.0
+
+
+def _run(cmd, env_extra=None, timeout=CHILD_TIMEOUT_S):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    # 1-device is plenty for the drill; drop any test-harness device forcing
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=timeout, env=env)
+    return proc.returncode, proc.stdout or ""
+
+
+def _coverage(run_dir):
+    """Min child-coverage fraction over the run's root spans (None when
+    the run produced no roots)."""
+    from raft_stereo_tpu.obs.events import read_events
+    from raft_stereo_tpu.obs.timeline import span_coverage
+    records = read_events(os.path.join(run_dir, "events.jsonl"))
+    spans = [r for r in records if r.get("event") == "span"]
+    cov = span_coverage(spans)
+    return cov.get("min") if cov.get("roots") else None
+
+
+def _check_run(leg, run_dir, expect_phase):
+    """timeline + doctor over one run dir; returns the drill record."""
+    errors = []
+    rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
+                    "timeline", run_dir])
+    if rc != 0:
+        errors.append(f"timeline rc={rc}: {out.splitlines()[-1:]}")
+    cov = _coverage(run_dir)
+    if cov is None:
+        errors.append("no root spans in the event stream")
+    elif cov < COVERAGE_MIN:
+        errors.append(f"span child coverage {cov:.0%} < "
+                      f"{COVERAGE_MIN:.0%}")
+    rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
+                    "doctor", run_dir, "--json"])
+    verdicts = {}
+    if rc != 0:
+        errors.append(f"doctor rc={rc}")
+    else:
+        try:
+            report = json.loads(out[out.index("{"):])
+            verdicts = {v["phase"]: v["verdict"]
+                        for v in report["verdicts"]}
+        except (ValueError, KeyError) as e:
+            errors.append(f"unparseable doctor report: {e}")
+    if verdicts and verdicts.get(expect_phase, "UNKNOWN") == "UNKNOWN":
+        errors.append(f"doctor verdict for {expect_phase!r} is UNKNOWN: "
+                      f"{verdicts}")
+    return {"drill": leg, "ok": not errors, "run_dir": run_dir,
+            "coverage_min": cov, "verdicts": verdicts,
+            "error": "; ".join(errors) or None}
+
+
+def drill_train(work):
+    from fault_drill import make_sceneflow_tree
+    make_sceneflow_tree(os.path.join(work, "data"))
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "train",
+        "--name", "trace", "--data_root", os.path.join(work, "data"),
+        "--ckpt_dir", os.path.join(work, "ckpts"),
+        "--run_dir", os.path.join(work, "runs"),
+        "--batch_size", "2", "--num_steps", "3",
+        "--image_size", "48", "64",
+        "--train_iters", "1", "--valid_iters", "1",
+        "--hidden_dims", "32", "32", "32",
+        "--validation_frequency", "1000000",
+        "--num_workers", "2", "--lr", "1e-4",
+        "--data_parallel", "1", "--stall_deadline_s", "0"])
+    if rc != 0:
+        return {"drill": "train", "ok": False,
+                "error": f"train rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    return _check_run("train", os.path.join(work, "runs", "trace"),
+                      "train")
+
+
+def drill_serve(work):
+    run_dir = os.path.join(work, "loadtest")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "loadtest",
+        "--run_dir", run_dir, "--no_baseline", "--no_progress",
+        "--shapes", "48x96", "64x128",
+        "--clients", "3", "--requests_per_client", "2",
+        "--video_streams", "0", "--max_batch", "2", "--window", "2",
+        "--iters", "1", "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "serve", "ok": False,
+                "error": f"loadtest rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    return _check_run("serve", os.path.join(run_dir, "serve"), "serve")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="timeline/doctor rehearsal over real tiny runs "
+                    "(see module doc)")
+    p.add_argument("--keep-work", action="store_true",
+                   help="keep the scratch tree (default: delete on exit)")
+    args = p.parse_args(argv)
+
+    from raft_stereo_tpu.obs.events import append_json_log
+
+    os.makedirs(OUT, exist_ok=True)
+    work = tempfile.mkdtemp(prefix="trace_drill_")
+    t0 = time.monotonic()
+    try:
+        records = [drill_train(work), drill_serve(work)]
+    finally:
+        if args.keep_work:
+            print(f"work tree kept: {work}")
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+    ok = True
+    for rec in records:
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        append_json_log(LOG, rec, stream=sys.stderr)
+        ok = ok and rec["ok"]
+    print(("TRACE DRILL ok: " if ok else "TRACE DRILL FAILED: ")
+          + ", ".join(f"{r['drill']}={'ok' if r['ok'] else 'FAIL'}"
+                      for r in records))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

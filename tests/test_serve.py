@@ -288,6 +288,9 @@ class _ExplodingCache:
 class _FakeCache:
     """Instant fake executable: constant finite outputs."""
 
+    def __len__(self):  # stats()/healthz report the resident-program count
+        return 1
+
     def __call__(self, key, im1, im2, flow_init=None):
         b, h, w, _ = im1.shape
         return (np.zeros((b, h // 4, w // 4, 2), np.float32),
@@ -335,6 +338,74 @@ def test_drain_on_unstarted_server_completes_inline(tmp_path):
     handles = [server.submit(*_pair(70 + i)) for i in range(3)]
     assert server.close(timeout=60)
     assert all(h.result(timeout=5).ok for h in handles)
+
+
+def test_http_metrics_exposition_after_load(tmp_path):
+    """Mini HTTP loadtest: POST a few /v1/predict requests, then assert
+    GET /metrics serves Prometheus text with the SLOTracker gauges and
+    monotone counters reflecting the load; --no_metrics turns it off."""
+    import io
+    import urllib.error
+    import urllib.request
+
+    from raft_stereo_tpu.serve.http import make_http_server
+
+    # the fake cache never runs the model, and ExecutableCache.__init__
+    # only hashes the pytree structure — a stub keeps this test off the
+    # ~10s eager init_model path (one-core suite budget)
+    stub_vars = {"params": {"w": np.zeros((1,), np.float32)}}
+    server = StereoServer(
+        RAFTStereoConfig(), stub_vars,
+        ServeConfig(max_batch=2, window=2, default_iters=ITERS,
+                    linger_s=0.05, slo_every=2),
+        autostart=False)
+    server.cache = _FakeCache()
+    server.start()
+    httpd = make_http_server(server, "127.0.0.1", 0)   # ephemeral port
+    t = __import__("threading").Thread(target=httpd.serve_forever,
+                                       daemon=True)
+    t.start()
+    base = "http://%s:%d" % httpd.server_address
+    try:
+        for i in range(3):
+            left, right = _pair(80 + i)
+            buf = io.BytesIO()
+            np.savez_compressed(buf, left=left, right=right)
+            req = urllib.request.Request(f"{base}/v1/predict",
+                                         data=buf.getvalue(), method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "# TYPE raft_serve_latency_p50_ms gauge" in text
+        assert "# TYPE raft_serve_requests_completed_total counter" in text
+        values = {line.split()[0]: float(line.split()[1])
+                  for line in text.splitlines()
+                  if line and not line.startswith("#")}
+        assert values["raft_serve_requests_admitted_total"] == 3
+        assert values["raft_serve_requests_completed_total"] == 3
+        assert values["raft_serve_requests_failed_total"] == 0
+        assert values["raft_serve_latency_p50_ms"] > 0
+        assert values["raft_serve_draining"] == 0
+        # the --no_metrics plumbing: a metrics-off frontend on the same
+        # server 404s the exposition (the handler never reaches the
+        # scheduler, so no second model init is needed)
+        httpd2 = make_http_server(server, "127.0.0.1", 0, metrics=False)
+        t2 = __import__("threading").Thread(target=httpd2.serve_forever,
+                                            daemon=True)
+        t2.start()
+        base2 = "http://%s:%d" % httpd2.server_address
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base2}/metrics", timeout=10)
+            assert exc_info.value.code == 404
+        finally:
+            httpd2.shutdown()
+    finally:
+        httpd.shutdown()
+        server.close(timeout=60)
 
 
 # --------------------------------------- PendingPrediction error capture
@@ -436,11 +507,12 @@ def test_cli_main_knows_serve_and_loadtest(capsys):
 
 
 def test_cli_drift_v3_fires_on_seeded_serve_fixture(tmp_path):
-    """Rule v3: an orphan flag on either serving surface is an error."""
+    """Rule v3 coverage: an orphan flag on either serving surface is an
+    error."""
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 3
+    assert RULE_VERSIONS["cli-drift"] == 4
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "serve").mkdir(parents=True)
     (pkg / "cli.py").write_text(
@@ -466,6 +538,64 @@ def test_cli_drift_v3_fires_on_seeded_serve_fixture(tmp_path):
     orphans = {f.data.get("dest") for f in findings
                if f.rule == "cli-drift" and f.severity == "error"}
     assert orphans == {"serve_orphan", "loadtest_orphan"}
+
+
+def test_cli_drift_v4_fires_on_seeded_timeline_doctor_fixture(tmp_path):
+    """Rule v4: the timeline/doctor surfaces drift the same way — a flag
+    declared in cli.py that neither cli.py nor the obs consumer module
+    reads is an orphan; flags the consumer reads stay clean."""
+    from raft_stereo_tpu.analysis.ast_rules import (
+        check_entry_surface_drift)
+
+    pkg = tmp_path / "raft_stereo_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "cli.py").write_text(
+        "def build_timeline_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('--out')\n"
+        "    p.add_argument('--timeline_orphan')\n"
+        "    return p\n"
+        "def build_doctor_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('--json')\n"
+        "    p.add_argument('--doctor_orphan')\n"
+        "    return p\n")
+    (pkg / "obs" / "timeline.py").write_text(
+        "def main(args):\n"
+        "    return args.out\n")
+    (pkg / "obs" / "doctor.py").write_text(
+        "def main(args):\n"
+        "    return getattr(args, 'json')\n")
+    findings = check_entry_surface_drift(str(tmp_path))
+    errors = [f for f in findings
+              if f.rule == "cli-drift" and f.severity == "error"]
+    orphans = {f.data.get("dest") for f in errors}
+    assert orphans == {"timeline_orphan", "doctor_orphan"}
+    surfaces = {f.data.get("surface") for f in errors}
+    assert surfaces == {"build_timeline_parser", "build_doctor_parser"}
+
+
+def test_cli_drift_v4_real_surfaces_are_clean():
+    """The shipped timeline/doctor/serve surfaces lint clean — every
+    declared flag (incl. --no_metrics / --no_trace plumbing) is read by
+    a consumer module."""
+    import os
+
+    import raft_stereo_tpu
+    from raft_stereo_tpu.analysis.ast_rules import (
+        check_cli_config_drift, check_entry_surface_drift)
+
+    root = os.path.dirname(os.path.dirname(raft_stereo_tpu.__file__))
+    errors = [f for f in check_entry_surface_drift(root)
+              if f.severity == "error"]
+    assert errors == []
+    cli_path = os.path.join(root, "raft_stereo_tpu", "cli.py")
+    errors = [f for f in check_cli_config_drift(cli_path,
+                                                "raft_stereo_tpu/cli.py")
+              if f.severity == "error"]
+    assert errors == []
 
 
 def test_loadtest_trace_covers_required_mix():
