@@ -41,18 +41,20 @@ from raft_stereo_tpu.analysis.findings import Finding
 
 #: current semantic version per rule (baseline entries record the version
 #: they suppress; a bump flags them stale — findings.apply_baseline).
-#: cli-drift is v4: v2 extended the rule to the evaluate_stereo/demo
+#: cli-drift is v5: v2 extended the rule to the evaluate_stereo/demo
 #: parser surfaces and the bench config-constructor call sites; v3 added
 #: the serving surfaces (build_serve_parser/build_loadtest_parser); v4
-#: adds the tracing/diagnosis surfaces (build_timeline_parser/
+#: added the tracing/diagnosis surfaces (build_timeline_parser/
 #: build_doctor_parser, consumed by obs/timeline.py and obs/doctor.py)
-#: plus the serve --no_metrics plumbing — so earlier suppressions no
-#: longer mean what they said.
+#: plus the serve --no_metrics plumbing; v5 adds the convergence surface
+#: (build_converge_parser, consumed by obs/converge.py) plus the
+#: --no_converge/--iter_epe plumbing on the eval and serve surfaces — so
+#: earlier suppressions no longer mean what they said.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 4,
+    "cli-drift": 5,
 }
 
 # Call names (last attribute segment) that trace their function arguments.
@@ -485,6 +487,10 @@ ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
                                "raft_stereo_tpu/obs/timeline.py")),
     ("build_doctor_parser", ("raft_stereo_tpu/cli.py",
                              "raft_stereo_tpu/obs/doctor.py")),
+    # convergence surface (rule v5): declared in cli.py, consumed by the
+    # early-exit simulator's main
+    ("build_converge_parser", ("raft_stereo_tpu/cli.py",
+                               "raft_stereo_tpu/obs/converge.py")),
 )
 
 #: modules whose own argparse surface must be self-consumed, and whose

@@ -276,6 +276,18 @@ def build_eval_parser() -> argparse.ArgumentParser:
                         "frames through one dispatch")
     g.add_argument("--decode_workers", type=int, default=2,
                    help="background frame-decode threads")
+    c = parser.add_argument_group(
+        "convergence", "iteration-resolved quality telemetry "
+        "(obs/converge.py): per-frame |delta disparity| curves on the "
+        "event bus, replayable offline by `cli converge <run_dir>`")
+    c.add_argument("--no_converge", action="store_true",
+                   help="disable the convergence aux entirely; the forward "
+                        "graph and event stream are bitwise-identical to "
+                        "pre-v8 eval")
+    c.add_argument("--iter_epe", action="store_true",
+                   help="additionally compute the in-graph per-iteration "
+                        "EPE against GT (needs datasets with flow; implies "
+                        "the convergence aux)")
     add_model_args(parser)
     return parser
 
@@ -321,6 +333,10 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                    help="skip AOT lower().compile(); jit on first call")
     g.add_argument("--slo_every", type=int, default=16,
                    help="emit one `slo` rollup event every N retirements")
+    g.add_argument("--no_converge", action="store_true",
+                   help="serve the 3-output program without the per-request "
+                        "convergence aux: no converge events, no per-bucket "
+                        "slo quality gauges (the schema-v7 pin)")
 
 
 def serve_config(args: argparse.Namespace):
@@ -329,7 +345,7 @@ def serve_config(args: argparse.Namespace):
         max_batch=args.max_batch, queue_depth=args.queue_depth,
         window=args.window, default_iters=args.iters, bucket=args.bucket,
         linger_s=args.linger_ms / 1e3, aot=not args.no_aot,
-        slo_every=args.slo_every)
+        slo_every=args.slo_every, converge=not args.no_converge)
 
 
 def _parse_shapes(specs) -> list:
@@ -395,6 +411,32 @@ def build_doctor_parser() -> argparse.ArgumentParser:
                         help="run directory (or events.jsonl path)")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON instead of text")
+    return parser
+
+
+def build_converge_parser() -> argparse.ArgumentParser:
+    """The ``cli converge`` flag surface (consumed by obs/converge.py)."""
+    parser = argparse.ArgumentParser(
+        prog="cli converge",
+        description="Early-exit what-if simulator: replay a run's recorded "
+                    "convergence curves against a grid of exit thresholds "
+                    "and print the decision table (iterations saved vs "
+                    "predicted EPE delta) — no model re-run")
+    parser.add_argument("run_dir",
+                        help="run directory (or events.jsonl path) holding "
+                             "converge events")
+    parser.add_argument("--taus", type=float, nargs="+", default=None,
+                        help="exit thresholds on the per-iteration mean "
+                             "|delta disparity| (px); default "
+                             "0.5 0.2 0.1 0.05 0.02 0.01")
+    parser.add_argument("--bucket_by", choices=["bucket", "all", "both"],
+                        default="both",
+                        help="row granularity: per shape bucket, pooled "
+                             "across buckets, or both")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the table as JSON instead of text")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON table to this path")
     return parser
 
 
@@ -622,7 +664,9 @@ def _eval_main():
     cfg = model_config(args)
     _, variables = load_variables(args.restore_ckpt, cfg)
     predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
-                                bucket=args.bucket)
+                                bucket=args.bucket,
+                                converge=not args.no_converge,
+                                iter_epe=args.iter_epe)
     from raft_stereo_tpu.eval.stream import StreamConfig
     stream = StreamConfig(
         enabled={"auto": None, "on": True, "off": False}[args.stream],
@@ -636,7 +680,9 @@ def _eval_main():
                               "valid_iters": args.valid_iters,
                               "stream": args.stream,
                               "stream_window": args.stream_window,
-                              "stream_microbatch": args.stream_microbatch})
+                              "stream_microbatch": args.stream_microbatch,
+                              "converge": not args.no_converge,
+                              "iter_epe": args.iter_epe})
     try:
         if args.dataset.startswith("middlebury_"):
             results = validate_middlebury(predictor, args.data_root,
@@ -673,6 +719,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
       timeline as Chrome/Perfetto JSON (obs/timeline.py),
     * ``doctor <run_dir>`` — rule-driven bottleneck diagnosis with
       evidence lines (obs/doctor.py),
+    * ``converge <run_dir>`` — the early-exit what-if simulator over a
+      run's recorded convergence curves (obs/converge.py; the ROADMAP 1(b)
+      decision table, computed offline),
     * ``serve`` — continuous-batching HTTP serving with SLO telemetry,
       graceful drain and SIGHUP hot reload (raft_stereo_tpu/serve),
     * ``loadtest`` — the synthetic many-client serving drill vs a
@@ -684,7 +733,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     argv = list(sys.argv[1:] if argv is None else argv)
     commands = ("telemetry", "compare", "lint", "timeline", "doctor",
-                "train", "eval", "serve", "loadtest")
+                "converge", "train", "eval", "serve", "loadtest")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -705,6 +754,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "doctor":
         from raft_stereo_tpu.obs.doctor import main as doctor_main
         return doctor_main(rest)
+    if cmd == "converge":
+        from raft_stereo_tpu.obs.converge import main as converge_main
+        return converge_main(rest)
     # the remaining mains parse sys.argv via argparse; present the
     # remainder as the whole command line
     sys.argv = [f"{sys.argv[0]} {cmd}"] + rest

@@ -106,14 +106,17 @@ def resolve_stream(stream: Union[None, bool, StreamConfig]) -> StreamConfig:
 
 def run_frames(predictor, dataset, consume: Consume, *, iters: int,
                stream: Union[None, bool, StreamConfig] = None,
-               telemetry=None, timed: bool = False) -> Dict[str, Any]:
+               telemetry=None, timed: bool = False,
+               source: Optional[str] = None) -> Dict[str, Any]:
     """Drive ``consume`` over every dataset frame, in index order.
 
     ``timed=True`` asks the sequential path for device-only timing via
     ``predictor.predict_timed`` (the KITTI validator's FPS discipline);
-    other validators use the single-dispatch ``__call__``. Returns a stats
-    dict (mode, wall seconds, frames/sec) for callers that report
-    throughput.
+    other validators use the single-dispatch ``__call__``. ``source``
+    labels the validator on emitted ``converge`` records (predictors built
+    with ``converge=True`` yield per-frame convergence curves; see
+    obs/converge.py). Returns a stats dict (mode, wall seconds,
+    frames/sec) for callers that report throughput.
     """
     cfg = resolve_stream(stream)
     use_stream = (hasattr(predictor, "predict_async")
@@ -122,11 +125,14 @@ def run_frames(predictor, dataset, consume: Consume, *, iters: int,
         raise ValueError(
             f"stream=on but {type(predictor).__name__} has no predict_async")
     n = len(dataset)
+    src = f"eval:{source or 'eval'}"
     t_run0 = time.perf_counter()
     if use_stream:
-        _run_streaming(predictor, dataset, consume, iters, cfg, telemetry)
+        _run_streaming(predictor, dataset, consume, iters, cfg, telemetry,
+                       src)
     else:
-        _run_sequential(predictor, dataset, consume, iters, telemetry, timed)
+        _run_sequential(predictor, dataset, consume, iters, telemetry, timed,
+                        src)
     wall = time.perf_counter() - t_run0
     return {
         "mode": "stream" if use_stream else "sequential",
@@ -146,18 +152,52 @@ def _emit_step(telemetry, index: int, timing: FrameTiming) -> None:
                        in_flight=timing.in_flight)
 
 
-def _run_sequential(predictor, dataset, consume, iters, telemetry, timed):
+def _gt_kwargs(predictor, samples) -> Dict[str, np.ndarray]:
+    """GT/validity kwargs feeding the in-graph iter-EPE aux — only when the
+    predictor asked for it (``iter_epe``) and every frame carries GT, so
+    stub predictors and GT-less datasets never see the extra kwargs."""
+    if not getattr(predictor, "iter_epe", False):
+        return {}
+    if not all("flow" in s for s in samples):
+        return {}
+    kw = {"flow_gt": np.stack([s["flow"] for s in samples])}
+    if all("valid" in s for s in samples):
+        kw["valid"] = np.stack([s["valid"] for s in samples])
+    return kw
+
+
+def _emit_converge(telemetry, source, sample, aux, j, index) -> None:
+    """One frame's ``converge`` record from a (possibly batched) aux."""
+    if telemetry is None or aux is None:
+        return
+    from raft_stereo_tpu.obs import converge as converge_obs
+    residual = np.asarray(aux["residual"])
+    res = residual[:, j] if residual.ndim == 2 else residual
+    epe = aux.get("epe")
+    if epe is not None:
+        epe = np.asarray(epe)
+        epe = epe[:, j] if epe.ndim == 2 else epe
+    h, w = sample["image1"].shape[:2]
+    converge_obs.emit(telemetry, source, len(res), res, epe=epe,
+                      bucket=f"{h}x{w}", frame=index)
+
+
+def _run_sequential(predictor, dataset, consume, iters, telemetry, timed,
+                    source):
     tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
+    take_aux = getattr(predictor, "take_aux", None)
     for i in range(len(dataset)):
         t_load = time.perf_counter()
         sample = dataset.sample(i)
+        gt_kw = _gt_kwargs(predictor, [sample])
         t0 = time.perf_counter()
         if timed:
             flow, dt_dev = predictor.predict_timed(
-                sample["image1"][None], sample["image2"][None], iters)
+                sample["image1"][None], sample["image2"][None], iters,
+                **gt_kw)
         else:
             flow = predictor(sample["image1"][None], sample["image2"][None],
-                             iters)
+                             iters, **gt_kw)
             dt_dev = None
         t1 = time.perf_counter()
         root = tracer.record("eval/frame", t_load, t1, index=i)
@@ -172,10 +212,13 @@ def _run_sequential(predictor, dataset, consume, iters, telemetry, timed):
             fetch_s=max((t1 - t0) - dispatch_s, 0.0), device_s=dt_dev,
             e2e_s=t1 - t0, batch_size=1, in_flight=1)
         _emit_step(telemetry, i, timing)
+        _emit_converge(telemetry, source, sample,
+                       take_aux() if take_aux is not None else None, 0, i)
         consume(i, sample, flow[0], timing)
 
 
-def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
+def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry,
+                   source):
     tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
     n = len(dataset)
     window = max(1, cfg.window)
@@ -212,6 +255,8 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
         group, handle, dispatch_s, data_wait_s, stamps = in_flight.popleft()
         tr0 = time.perf_counter()
         flows = handle.result()  # (B, H, W, 1); blocks until the device is done
+        aux_fn = getattr(handle, "aux_result", None)
+        aux = aux_fn() if aux_fn is not None else None
         tr1 = time.perf_counter()
         fetch_s = getattr(handle, "fetch_s", None) or 0.0
         b = len(group)
@@ -234,6 +279,7 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
                 in_flight=len(in_flight))
             t_last_retire = now
             _emit_step(telemetry, idx, timing)
+            _emit_converge(telemetry, source, sample, aux, j, idx)
             consume(idx, sample, flows[j], timing)
 
     try:
@@ -265,8 +311,9 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry):
                     key=lambda item: item[1]["image1"].shape)
                 wait = sum(waits)
                 im1, im2 = stack_pairs([s for _, s in group])
+                gt_kw = _gt_kwargs(predictor, [s for _, s in group])
                 t0 = time.perf_counter()
-                handle = predictor.predict_async(im1, im2, iters)
+                handle = predictor.predict_async(im1, im2, iters, **gt_kw)
                 t1 = time.perf_counter()
                 dispatch_s = t1 - t0
                 in_flight.append((group, handle, dispatch_s, wait,

@@ -44,11 +44,16 @@ class PendingPrediction:
     deferred so callers can keep dispatching while earlier frames compute.
     """
 
-    def __init__(self, flow_dev, unpad: Callable, dispatch_s: float):
+    def __init__(self, flow_dev, unpad: Callable, dispatch_s: float,
+                 aux: Optional[Dict[str, Any]] = None):
         self._flow = flow_dev
         self._unpad = unpad
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        # convergence aux device arrays (residual/epe curves), fetched
+        # lazily by aux_result() so the deferred-D2H contract holds
+        self._aux = aux
+        self._aux_np: Optional[Dict[str, np.ndarray]] = None
         #: host seconds spent inside the dispatching call (async enqueue,
         #: not device time)
         self.dispatch_s = dispatch_s
@@ -96,6 +101,15 @@ class PendingPrediction:
             self._flow = None  # release the device buffer reference
         return self._result
 
+    def aux_result(self) -> Optional[Dict[str, np.ndarray]]:
+        """The convergence aux curves as numpy (``{"residual": (iters, B)``,
+        optionally ``"epe": (iters, B)}``), or None when the predictor ran
+        without them. Blocks like :meth:`result`; fetched once."""
+        if self._aux is not None and self._aux_np is None:
+            self._aux_np = {k: np.asarray(v) for k, v in self._aux.items()}
+            self._aux = None
+        return self._aux_np
+
 
 class StereoPredictor:
     """Jitted stereo inference with per-shape compile caching.
@@ -106,13 +120,24 @@ class StereoPredictor:
     """
 
     def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
-                 valid_iters: int = 32, bucket: int = 0):
+                 valid_iters: int = 32, bucket: int = 0,
+                 converge: bool = False, iter_epe: bool = False):
         self.cfg = cfg
         self.model = create_model(cfg)
         self.variables = variables
         self.valid_iters = valid_iters
         self.bucket = bucket
-        self._compiled: Dict[Tuple[int, int, int, int], Any] = {}
+        #: record per-sample convergence curves (iter_metrics="per_sample"
+        #: aux — the compiled forward gains one tiny reduction per
+        #: iteration); False keeps the exact prior program
+        self.converge = converge
+        #: additionally compute the in-graph per-iteration low-res EPE
+        #: proxy when the caller supplies ground truth (implies converge)
+        self.iter_epe = iter_epe
+        if iter_epe:
+            self.converge = True
+        self._last_aux: Optional[Dict[str, np.ndarray]] = None
+        self._compiled: Dict[Tuple, Any] = {}
         # "ring" shards the width axis over every available device (sequence
         # parallelism for very wide pairs). Pad W so each device's 1/factor-
         # resolution shard still pools 2^(levels-1)-fold locally.
@@ -129,21 +154,36 @@ class StereoPredictor:
             self._w_divis = math.lcm(
                 PAD_DIVIS, cfg.factor * n * 2 ** (cfg.corr_levels - 1))
 
-    def _forward(self, shape: Tuple[int, int, int], iters: int):
-        key = shape + (iters,)
+    def _forward(self, shape: Tuple[int, int, int], iters: int,
+                 with_gt: bool = False):
+        key = shape + (iters, self.converge, with_gt)
         fn = self._compiled.get(key)
         if fn is None:
             model = self.model
 
-            def run(variables, image1, image2):
-                return model.apply(variables, image1, image2, iters=iters,
-                                   test_mode=True)
+            if self.converge and with_gt:
+                def run(variables, image1, image2, flow_gt, valid):
+                    return model.apply(variables, image1, image2,
+                                       iters=iters, test_mode=True,
+                                       iter_metrics="per_sample",
+                                       flow_gt=flow_gt, loss_mask=valid)
+            elif self.converge:
+                def run(variables, image1, image2):
+                    return model.apply(variables, image1, image2,
+                                       iters=iters, test_mode=True,
+                                       iter_metrics="per_sample")
+            else:
+                # converge off: the exact prior program (the --no_converge
+                # zero-overhead pin, tests/test_converge.py)
+                def run(variables, image1, image2):
+                    return model.apply(variables, image1, image2,
+                                       iters=iters, test_mode=True)
 
             fn = jax.jit(run)
             self._compiled[key] = fn
         return fn
 
-    def _prepared(self, image1, image2, iters):
+    def _prepared(self, image1, image2, iters, flow_gt=None, valid=None):
         """Shared pad/shard/compile-lookup for the timed and untimed paths."""
         import contextlib
         iters = self.valid_iters if iters is None else iters
@@ -155,29 +195,63 @@ class StereoPredictor:
             target=(bucket_size(h, PAD_DIVIS, self.bucket),
                     bucket_size(w, self._w_divis, self.bucket)))
         im1, im2 = padder.pad(image1, image2)
+        gt_args: Tuple = ()
+        if self.iter_epe and flow_gt is not None:
+            # GT/validity get ZERO padding: edge replication would mark the
+            # padded border as valid signal, skewing the pooled-EPE aux
+            gt = jnp.asarray(flow_gt, jnp.float32)
+            va = (jnp.ones(gt.shape, jnp.float32) if valid is None
+                  else jnp.asarray(valid, jnp.float32).reshape(gt.shape))
+            gt_args = tuple(padder.pad_zeros(gt, va))
         ctx = self._mesh if self._mesh is not None else contextlib.nullcontext()
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from raft_stereo_tpu.parallel.mesh import SEQ_AXIS
             spec = NamedSharding(self._mesh, P(None, None, SEQ_AXIS, None))
             im1, im2 = jax.device_put(im1, spec), jax.device_put(im2, spec)
-        fn = self._forward(tuple(im1.shape[:3]), iters)
-        return padder, fn, im1, im2, ctx
+            if gt_args:
+                gt_args = tuple(jax.device_put(x, spec) for x in gt_args)
+        fn = self._forward(tuple(im1.shape[:3]), iters,
+                           with_gt=bool(gt_args))
+        return padder, fn, im1, im2, gt_args, ctx
+
+    def _stash_aux(self, outs) -> None:
+        """Fetch + stash the converge aux of a sync call for take_aux()."""
+        if not self.converge:
+            return
+        aux = {"residual": np.asarray(outs[2])}
+        if len(outs) > 3:
+            aux["epe"] = np.asarray(outs[3])
+        self._last_aux = aux
+
+    def take_aux(self) -> Optional[Dict[str, np.ndarray]]:
+        """Pop the convergence aux curves of the LAST synchronous call
+        (``__call__``/``predict_timed``) — ``{"residual": (iters, B)``,
+        optionally ``"epe"}`` — or None when converge is off. The async
+        path carries its aux on the handle instead
+        (:meth:`PendingPrediction.aux_result`)."""
+        aux, self._last_aux = self._last_aux, None
+        return aux
 
     def __call__(self, image1: np.ndarray, image2: np.ndarray,
-                 iters: Optional[int] = None) -> np.ndarray:
+                 iters: Optional[int] = None, flow_gt=None,
+                 valid=None) -> np.ndarray:
         """Batched NHWC uint8-range images -> flow-x ``(B, H, W, 1)`` (negative
         disparity), matching the reference's ``flow_up`` output. Untimed: one
         dispatch, one D2H fetch — the timing discipline's extra round-trips
-        live only in :meth:`predict_timed`."""
-        padder, fn, im1, im2, ctx = self._prepared(image1, image2, iters)
+        live only in :meth:`predict_timed`. ``flow_gt``/``valid`` feed the
+        iter-EPE aux (only read when the predictor was built with
+        ``iter_epe=True``; see :meth:`take_aux`)."""
+        padder, fn, im1, im2, gt_args, ctx = self._prepared(
+            image1, image2, iters, flow_gt, valid)
         with ctx:
-            _, flow_up = fn(self.variables, im1, im2)
-        return np.asarray(padder.unpad(flow_up))
+            outs = fn(self.variables, im1, im2, *gt_args)
+        self._stash_aux(outs)
+        return np.asarray(padder.unpad(outs[1]))
 
     def predict_timed(self, image1: np.ndarray, image2: np.ndarray,
-                      iters: Optional[int] = None
-                      ) -> Tuple[np.ndarray, float]:
+                      iters: Optional[int] = None, flow_gt=None,
+                      valid=None) -> Tuple[np.ndarray, float]:
         """Like ``__call__`` but also returns the DEVICE-ONLY seconds of the
         jitted forward — the number comparable to the reference's model-call
         timing (evaluate_stereo.py:77-79, which brackets only
@@ -191,17 +265,23 @@ class StereoPredictor:
         executable does. The full-array D2H fetch happens after ``t1``.
         """
         import time as _time
-        padder, fn, im1, im2, ctx = self._prepared(image1, image2, iters)
+        padder, fn, im1, im2, gt_args, ctx = self._prepared(
+            image1, image2, iters, flow_gt, valid)
         with ctx:
             im1, im2 = jax.block_until_ready((im1, im2))
+            if gt_args:
+                gt_args = jax.block_until_ready(gt_args)
             t0 = _time.perf_counter()
-            _, flow_up = fn(self.variables, im1, im2)
+            outs = fn(self.variables, im1, im2, *gt_args)
+            flow_up = outs[1]
             float(flow_up[0, 0, 0, 0])  # host fetch of one element = sync
             dt = _time.perf_counter() - t0
+        self._stash_aux(outs)  # aux D2H lands after the timing stops
         return np.asarray(padder.unpad(flow_up)), dt
 
     def predict_async(self, image1: np.ndarray, image2: np.ndarray,
-                      iters: Optional[int] = None) -> PendingPrediction:
+                      iters: Optional[int] = None, flow_gt=None,
+                      valid=None) -> PendingPrediction:
         """Dispatch one batched forward and return immediately.
 
         Inputs are staged onto the device and the jitted call is enqueued
@@ -213,11 +293,17 @@ class StereoPredictor:
         exactly like the training loop's chained dispatch (see
         eval/stream.py, which drives this)."""
         t0 = time.perf_counter()
-        padder, fn, im1, im2, ctx = self._prepared(image1, image2, iters)
+        padder, fn, im1, im2, gt_args, ctx = self._prepared(
+            image1, image2, iters, flow_gt, valid)
         with ctx:
-            _, flow_up = fn(self.variables, im1, im2)
-        return PendingPrediction(flow_up, padder.unpad,
-                                 time.perf_counter() - t0)
+            outs = fn(self.variables, im1, im2, *gt_args)
+        aux = None
+        if self.converge:
+            aux = {"residual": outs[2]}
+            if len(outs) > 3:
+                aux["epe"] = outs[3]
+        return PendingPrediction(outs[1], padder.unpad,
+                                 time.perf_counter() - t0, aux=aux)
 
     def compute_disparity(self, left: np.ndarray, right: np.ndarray,
                           iters: Optional[int] = None) -> np.ndarray:

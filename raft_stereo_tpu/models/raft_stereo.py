@@ -283,13 +283,24 @@ class RAFTStereo(nn.Module):
         gradients are identical up to XLA scheduling.
 
         ``iter_metrics`` (test mode only): additionally return the
-        per-iteration mean |delta disparity| — an ``(iters,)`` in-graph aux
-        output measuring how much each GRU iteration still moves the field
-        (the convergence axis of the serial-floor decomposition,
-        scripts/serial_floor.py). Computed from consecutive carries, so the
-        scanned graph gains one tiny reduction per iteration and nothing
-        else changes; the return becomes ``(flow_lowres, flow_up,
+        per-iteration mean |delta disparity| — an in-graph aux output
+        measuring how much each GRU iteration still moves the field (the
+        convergence axis of the serial-floor decomposition,
+        scripts/serial_floor.py). ``True`` returns the batch-mean curve
+        ``(iters,)``; ``"per_sample"`` returns ``(iters, B)`` (mean over
+        H, W per sample — what the convergence observatory records per
+        frame/request). Computed from consecutive carries, so the scanned
+        graph gains one tiny reduction per iteration and nothing else
+        changes; the return becomes ``(flow_lowres, flow_up,
         delta_norms)``.
+
+        Passing ``flow_gt`` in test mode (requires ``iter_metrics``)
+        additionally returns the per-iteration low-res EPE proxy against
+        the factor-pooled ground truth — ``loss_mask`` (same shape) marks
+        valid GT pixels, pooled cells with no valid pixel are excluded —
+        shaped like ``delta_norms``; the return becomes ``(flow_lowres,
+        flow_up, delta_norms, epes)``. With ``flow_gt=None`` the graph is
+        byte-identical to the plain ``iter_metrics`` one.
         """
         cfg = self.cfg
         dt = self.compute_dtype
@@ -403,6 +414,10 @@ class RAFTStereo(nn.Module):
         if iter_metrics and not test_mode:
             raise ValueError("iter_metrics aux outputs exist on the "
                              "test_mode (inference) scan only")
+        if test_mode and flow_gt is not None and not iter_metrics:
+            raise ValueError("the test_mode iter-EPE aux rides the "
+                             "iter_metrics scan outputs; pass "
+                             "iter_metrics=True or 'per_sample'")
         cfg = self.cfg
         dt = self.compute_dtype
 
@@ -460,7 +475,7 @@ class RAFTStereo(nn.Module):
             flow_init = flow_init.at[..., 1].set(0.0)
             coords1 = coords1 + flow_init
 
-        fused = flow_gt is not None
+        fused = flow_gt is not None and not test_mode
         if fused and loss_mask is None:
             raise ValueError("the fused-loss path needs both flow_gt and "
                              "loss_mask (see training.loss.loss_mask)")
@@ -481,27 +496,63 @@ class RAFTStereo(nn.Module):
                                     name="refinement")
             carry = (tuple(net_list), coords1)
 
+            per_sample = iter_metrics == "per_sample"
+
+            def _residual(c_new, c_old):
+                # per-iteration mean |delta disparity| from consecutive
+                # carries — the convergence aux of iter_metrics
+                d = jnp.abs((c_new[1] - c_old[1])[..., 0])
+                return jnp.mean(d, axis=(1, 2)) if per_sample else jnp.mean(d)
+
+            # In-graph low-res EPE proxy (flow_gt): pool the full-res GT to
+            # the flow grid with mask-weighted means computed ONCE outside
+            # the scan; each iteration then adds a single masked reduction
+            # against the current coords. Cells with no valid GT pixel are
+            # excluded from both numerator and denominator.
+            iter_epe = None
+            if flow_gt is not None:
+                f = cfg.factor
+                gt = flow_gt.astype(jnp.float32)[..., 0]
+                m = (jnp.ones_like(gt) if loss_mask is None
+                     else loss_mask.astype(jnp.float32)[..., 0])
+                gt_c = gt.reshape(b, h, f, w, f)
+                m_c = m.reshape(b, h, f, w, f)
+                msum = m_c.sum(axis=(2, 4))
+                gt_pool = (gt_c * m_c).sum(axis=(2, 4)) / jnp.maximum(msum,
+                                                                      1.0)
+                cell_valid = (msum > 0).astype(jnp.float32)
+                denom = jnp.maximum(cell_valid.sum(axis=(1, 2)), 1.0)
+
+                def _epe_of(c):
+                    err = jnp.abs((c[1] - coords0)[..., 0] * f - gt_pool)
+                    e = jnp.sum(err * cell_valid, axis=(1, 2)) / denom
+                    return e if per_sample else jnp.mean(e)
+
+                iter_epe = _epe_of
+
             def scan_iter(mdl, c, _):
                 c2, _unused = mdl(c, corr_state, tuple(inp_list), coords0,
                                   None, compute_mask=False)
-                # per-iteration mean |delta disparity| from consecutive
-                # carries — the convergence aux of iter_metrics; None keeps
-                # the default graph byte-identical
-                y = (jnp.mean(jnp.abs((c2[1] - c[1])[..., 0]))
-                     if iter_metrics else None)
+                # aux ys; None keeps the default graph byte-identical
+                y = _residual(c2, c) if iter_metrics else None
+                if iter_epe is not None:
+                    y = (y, iter_epe(c2))
                 return c2, y
 
             delta_norms = None
+            scanned_epes = None
             if iters > 1:
-                carry, scanned_norms = nn.scan(
+                carry, scanned = nn.scan(
                     scan_iter,
                     variable_broadcast="params",
                     split_rngs={"params": False},
                     length=iters - 1,
                     unroll=cfg.scan_unroll,
                 )(refine, carry, None)
+                if iter_epe is not None:
+                    scanned, scanned_epes = scanned
                 if iter_metrics:
-                    delta_norms = scanned_norms
+                    delta_norms = scanned
             pre_final = carry
             carry, mask = refine(carry, corr_state, tuple(inp_list), coords0,
                                  None)
@@ -509,10 +560,14 @@ class RAFTStereo(nn.Module):
             flow_up = upsample_disparity_convex(coords1 - coords0, mask,
                                                 cfg.factor)
             if iter_metrics:
-                final_norm = jnp.mean(
-                    jnp.abs((carry[1] - pre_final[1])[..., 0]))[None]
+                final_norm = _residual(carry, pre_final)[None]
                 delta_norms = (final_norm if delta_norms is None else
                                jnp.concatenate([delta_norms, final_norm]))
+                if iter_epe is not None:
+                    final_epe = iter_epe(carry)[None]
+                    epes = (final_epe if scanned_epes is None else
+                            jnp.concatenate([scanned_epes, final_epe]))
+                    return coords1 - coords0, flow_up, delta_norms, epes
                 return coords1 - coords0, flow_up, delta_norms
             return coords1 - coords0, flow_up
         if fused and not deferred:

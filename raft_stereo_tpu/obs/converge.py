@@ -1,0 +1,276 @@
+"""Convergence observatory: record iteration-resolved quality curves,
+then answer the ROADMAP 1(b) question offline.
+
+RAFT-Stereo's update operator is an anytime estimator: the model already
+measures, in-graph, how much each GRU iteration still moves the disparity
+field (``iter_metrics``, models/raft_stereo.py) and — when ground truth is
+available — the per-iteration low-res EPE proxy. This module is the
+recording and decision layer on top of those aux outputs:
+
+* :func:`converge_payload` / :func:`emit` — downsample one curve (strictly
+  increasing iteration indices, endpoints always kept) and put a schema-v8
+  ``converge`` record on the telemetry bus: one event per evaluated frame
+  or served request.
+* :func:`simulate` / :func:`decision_table` — the early-exit what-if
+  simulator: replay recorded curves against a grid of exit thresholds τ
+  (exit at the first iteration whose residual drops to τ) × bucket
+  granularities, WITHOUT re-running the model. The output is the 1(b)
+  decision table: predicted iterations saved and predicted EPE delta, per
+  source (validator / serve bucket) and per shape bucket.
+* :func:`main` — ``cli converge <run_dir>`` over a recorded run.
+* :func:`exit_percentile` — "by which iteration had p95 converged?"; the
+  evidence behind the doctor's OVER_ITERATED verdict (obs/doctor.py).
+
+The curves are disparity-residual curves in low-res pixels: τ is "the
+mean |Δdisparity| one more iteration would still apply". The serial-floor
+decomposition (scripts/serial_floor.py: 342.7 ms fixed + 55.2 ms/iter at
+22 iterations) prices every saved iteration; this table predicts how many
+a given τ saves and what it costs in EPE.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: stored points per curve (endpoints always kept; full curve when the
+#: iteration budget is already this small)
+DEFAULT_MAX_POINTS = 32
+
+#: default early-exit threshold grid (mean |Δdisparity|, low-res px)
+DEFAULT_TAUS = (0.5, 0.2, 0.1, 0.05, 0.02, 0.01)
+
+#: the doctor's "converged" threshold (see obs/doctor.py OVER_ITERATED)
+DOCTOR_TAU = 0.05
+
+
+# --- recording -------------------------------------------------------------
+
+def downsample(values: Sequence[float],
+               max_points: int = DEFAULT_MAX_POINTS
+               ) -> Tuple[List[int], List[float]]:
+    """Pick <= max_points strictly increasing indices covering [0, n-1].
+
+    Both endpoints are always kept (the simulator needs the final value;
+    half-life needs the first). Uniform stride in between.
+    """
+    n = len(values)
+    if n == 0:
+        return [], []
+    if max_points < 2:
+        max_points = 2
+    if n <= max_points:
+        idx = list(range(n))
+    else:
+        idx = sorted({round(i * (n - 1) / (max_points - 1))
+                      for i in range(max_points)})
+    return idx, [float(values[i]) for i in idx]
+
+
+def half_life(idx: Sequence[int], residual: Sequence[float]) -> Optional[int]:
+    """First stored iteration index where the residual fell to half its
+    initial value (None when it never did within the recorded curve)."""
+    if not residual:
+        return None
+    target = residual[0] / 2.0
+    for i, v in zip(idx, residual):
+        if v <= target:
+            return int(i)
+    return None
+
+
+def converge_payload(source: str, iters: int, residual: Sequence[float], *,
+                     epe: Optional[Sequence[float]] = None,
+                     bucket: Optional[str] = None,
+                     max_points: int = DEFAULT_MAX_POINTS,
+                     **extra: Any) -> Dict[str, Any]:
+    """Build one ``converge`` event payload from a full-length curve."""
+    idx, res = downsample(residual, max_points)
+    payload: Dict[str, Any] = {
+        "source": source, "iters": int(iters), "idx": idx, "residual": res,
+    }
+    if epe is not None:
+        payload["epe"] = [float(epe[i]) for i in idx]
+    if bucket is not None:
+        payload["bucket"] = bucket
+    if res:
+        payload["final_residual"] = res[-1]
+        hl = half_life(idx, res)
+        if hl is not None:
+            payload["half_life"] = hl
+    payload.update(extra)
+    return payload
+
+
+def emit(telemetry, source: str, iters: int, residual: Sequence[float], *,
+         epe: Optional[Sequence[float]] = None,
+         bucket: Optional[str] = None, **extra: Any) -> None:
+    """Downsample + emit one frame/request's curve on the bus (no-op
+    without a telemetry sink — observability never gates the data path)."""
+    if telemetry is None:
+        return
+    telemetry.emit("converge", **converge_payload(
+        source, iters, residual, epe=epe, bucket=bucket, **extra))
+
+
+# --- the early-exit simulator ----------------------------------------------
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """All ``converge`` records from a run dir (or events.jsonl path)."""
+    from raft_stereo_tpu.obs.events import read_events
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [r for r in read_events(path) if r.get("event") == "converge"]
+
+
+def exit_iter(idx: Sequence[int], residual: Sequence[float],
+              tau: float) -> Optional[int]:
+    """Iterations an early-exit policy at threshold tau would have spent:
+    idx[k]+1 at the first stored point with residual <= tau (None when the
+    curve never converged within the recorded budget)."""
+    for i, v in zip(idx, residual):
+        if v <= tau:
+            return int(i) + 1
+    return None
+
+
+def simulate(rec: Dict[str, Any], tau: float) -> Dict[str, Any]:
+    """What exiting at tau would have done to ONE recorded curve."""
+    iters = int(rec["iters"])
+    used = exit_iter(rec["idx"], rec["residual"], tau)
+    converged = used is not None
+    used = used if converged else iters
+    out = {"converged": converged, "exit_iter": used,
+           "saved": iters - used, "epe_delta": None}
+    epe = rec.get("epe")
+    if epe:
+        k = rec["idx"].index(used - 1) if converged else len(epe) - 1
+        out["epe_delta"] = float(epe[k]) - float(epe[-1])
+    return out
+
+
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (the serve/slo.py convention)."""
+    if not values:
+        return float("nan")
+    vals = sorted(values)
+    rank = max(1, math.ceil(q / 100.0 * len(vals)))
+    return vals[rank - 1]
+
+
+def exit_percentile(records: Iterable[Dict[str, Any]], tau: float = DOCTOR_TAU,
+                    q: float = 95.0) -> Optional[Dict[str, Any]]:
+    """"By which iteration had q% of frames converged (at tau)?" — over-
+    iteration evidence. Never-converged curves count as the full budget, so
+    the percentile cannot claim headroom convergence didn't earn."""
+    recs = list(records)
+    if not recs:
+        return None
+    exits, n_conv = [], 0
+    for r in recs:
+        sim = simulate(r, tau)
+        exits.append(float(sim["exit_iter"]))
+        n_conv += bool(sim["converged"])
+    return {"n": len(recs), "n_converged": n_conv, "tau": tau, "q": q,
+            "budget": max(int(r["iters"]) for r in recs),
+            "exit_iter": int(_percentile(exits, q))}
+
+
+def decision_table(records: Iterable[Dict[str, Any]],
+                   taus: Sequence[float] = DEFAULT_TAUS,
+                   bucket_by: str = "both") -> List[Dict[str, Any]]:
+    """The ROADMAP 1(b) decision table over recorded curves.
+
+    One row per (source, bucket granularity, tau): how many curves, the
+    p50/p95 exit iteration, mean predicted iterations saved, and the mean
+    predicted EPE delta (None when no curve carried the EPE aux).
+    ``bucket_by``: "bucket" (per shape bucket), "all" (collapsed), or
+    "both".
+    """
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for rec in records:
+        source = str(rec.get("source", "?"))
+        keys = []
+        if bucket_by in ("bucket", "both"):
+            keys.append((source, str(rec.get("bucket", "?"))))
+        if bucket_by in ("all", "both"):
+            keys.append((source, "*"))
+        for key in keys:
+            groups.setdefault(key, []).append(rec)
+    rows: List[Dict[str, Any]] = []
+    for (source, bucket) in sorted(groups):
+        recs = groups[(source, bucket)]
+        budget = max(int(r["iters"]) for r in recs)
+        for tau in taus:
+            sims = [simulate(r, tau) for r in recs]
+            exits = [float(s["exit_iter"]) for s in sims]
+            deltas = [s["epe_delta"] for s in sims
+                      if s["epe_delta"] is not None]
+            rows.append({
+                "source": source, "bucket": bucket, "tau": tau,
+                "n": len(recs), "budget": budget,
+                "converged_frac": sum(s["converged"] for s in sims)
+                / len(sims),
+                "exit_p50": int(_percentile(exits, 50.0)),
+                "exit_p95": int(_percentile(exits, 95.0)),
+                "saved_mean": sum(s["saved"] for s in sims) / len(sims),
+                "epe_delta_mean": (sum(deltas) / len(deltas)
+                                   if deltas else None),
+                "n_epe": len(deltas),
+            })
+    return rows
+
+
+def format_table(rows: List[Dict[str, Any]]) -> str:
+    """Render the decision table for the terminal."""
+    header = (f"{'source':<18} {'bucket':<12} {'tau':>6} {'n':>5} "
+              f"{'conv%':>6} {'p50':>4} {'p95':>4} {'saved':>6} "
+              f"{'epe_delta':>10}")
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        delta = ("-" if r["epe_delta_mean"] is None
+                 else f"{r['epe_delta_mean']:+.3f}")
+        lines.append(
+            f"{r['source']:<18} {r['bucket']:<12} {r['tau']:>6g} "
+            f"{r['n']:>5} {100.0 * r['converged_frac']:>5.0f}% "
+            f"{r['exit_p50']:>4} {r['exit_p95']:>4} "
+            f"{r['saved_mean']:>6.1f} {delta:>10}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``cli converge <run_dir>`` — the offline early-exit simulator."""
+    from raft_stereo_tpu.cli import build_converge_parser
+    args = build_converge_parser().parse_args(argv)
+    records = load_records(args.run_dir)
+    if not records:
+        print(f"no converge records under {args.run_dir} — run eval/serve "
+              "with convergence telemetry on (it is the default; "
+              "--no_converge disables it)", file=sys.stderr)
+        return 1
+    taus = tuple(args.taus) if args.taus else DEFAULT_TAUS
+    rows = decision_table(records, taus=taus, bucket_by=args.bucket_by)
+    doc = {"run_dir": args.run_dir, "curves": len(records),
+           "taus": list(taus), "bucket_by": args.bucket_by,
+           "table": rows}
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        budget = max(int(r["iters"]) for r in records)
+        print(f"{len(records)} curves, iteration budget {budget} "
+              f"({args.run_dir})")
+        print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,10 +22,17 @@ that earned it:
   serial-floor work).
 * **BALANCED** — nothing dominates; **UNKNOWN** only when the log holds
   no usable evidence at all.
+* **OVER_ITERATED** (own phase, additive) — schema-v8 ``converge`` curves
+  show p95 of frames/requests settled (residual <= obs/converge.py's
+  DOCTOR_TAU) well before the configured iteration budget: the run spent
+  device time refining disparities that had stopped moving. Evidence
+  quotes "p95 converged by iter k of N" and points at ``cli converge``
+  for the full threshold sweep.
 
 Rules read the ``step``/``request``/``slo``/``loader``/``stall``/
 ``compile`` records (all pre-v7), so doctor works on old artifacts too;
-v7 spans sharpen the serve phase split when present.
+v7 spans sharpen the serve phase split, v8 converge curves add the
+over-iteration rule, when present.
 """
 
 from __future__ import annotations
@@ -42,6 +49,11 @@ COMPILE_STORM_WALL_FRAC = 0.5
 DATA_STARVED_FRAC = 0.4
 COMPUTE_BOUND_FRAC = 0.6
 QUEUE_SATURATED_FRAC = 0.5
+# OVER_ITERATED: p95 exit iteration must undercut the budget by at least
+# this many iterations (a 1-iteration margin is measurement noise, not a
+# tuning opportunity), over at least this many curves
+OVER_ITERATED_MARGIN = 2
+OVER_ITERATED_MIN_CURVES = 4
 
 
 def _median(xs: Sequence[float]) -> float:
@@ -193,6 +205,31 @@ def _diagnose_serve(records) -> Optional[Dict[str, Any]]:
     ])
 
 
+def _diagnose_converge(records) -> Optional[Dict[str, Any]]:
+    """OVER_ITERATED: the recorded convergence curves prove the iteration
+    budget overshoots where the estimate stops moving."""
+    from raft_stereo_tpu.obs.converge import DOCTOR_TAU, exit_percentile
+    curves = [r for r in records if r.get("event") == "converge"]
+    if len(curves) < OVER_ITERATED_MIN_CURVES:
+        return None
+    ev = exit_percentile(curves, tau=DOCTOR_TAU, q=95.0)
+    if ev is None:
+        return None
+    budget, p95 = ev["budget"], ev["exit_iter"]
+    if p95 > budget - OVER_ITERATED_MARGIN:
+        return None
+    return _verdict("converge", "OVER_ITERATED", [
+        f"p95 converged by iter {p95} of {budget} (residual <= "
+        f"{ev['tau']}px over {ev['n']} curves, "
+        f"{ev['n_converged']}/{ev['n']} converged within budget)",
+        f"the last {budget - p95} iterations refine disparities that "
+        f"have stopped moving — device time with no quality return",
+        "replay exit thresholds against these curves with "
+        "`cli converge <run_dir>` (no model re-run) before lowering "
+        "the budget",
+    ])
+
+
 def diagnose(run_dir: str) -> Dict[str, Any]:
     """Diagnose one run dir; returns ``{"run_dir", "verdicts": [...]}``.
 
@@ -203,7 +240,8 @@ def diagnose(run_dir: str) -> Dict[str, Any]:
                    if os.path.isdir(run_dir) else run_dir)
     records = read_events(events_path)
     verdicts = [v for v in (_diagnose_train(records),
-                            _diagnose_serve(records)) if v is not None]
+                            _diagnose_serve(records),
+                            _diagnose_converge(records)) if v is not None]
     if not verdicts:
         verdicts = [_verdict("run", "UNKNOWN", [
             "no step or request records — nothing to diagnose"])]

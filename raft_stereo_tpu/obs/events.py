@@ -27,19 +27,20 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 # Back-compat: every schema version whose artifacts are still readable.
 # v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
 # op_counts jaxpr profile event), v3 -> v4 (the graftlint `lint` report
 # event), v4 -> v5 (the fault-tolerance events: preempt/resume/
 # ckpt_integrity/anomaly), v5 -> v6 (the serving events: request/queue/
-# slo) and v6 -> v7 (the tracing events: span/flightrec) were purely
-# ADDITIVE — no earlier event changed its required fields — so
-# pre-existing runs/*/events.jsonl lint clean: an older record is
-# validated against its own surface (it just may not use events
-# introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+# slo), v6 -> v7 (the tracing events: span/flightrec) and v7 -> v8 (the
+# convergence-observatory `converge` event; the `slo` quality fields ride
+# as optional extras) were purely ADDITIVE — no earlier event changed its
+# required fields — so pre-existing runs/*/events.jsonl lint clean: an
+# older record is validated against its own surface (it just may not use
+# events introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -57,6 +58,7 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "slo": 6,
     "span": 7,
     "flightrec": 7,
+    "converge": 8,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -143,6 +145,20 @@ EVENT_TYPES: Dict[str, tuple] = {
     # event/span rings at full resolution.
     "span": ("name", "span_id", "trace_id", "start_s", "dur_s"),
     "flightrec": ("reason", "path"),
+    # Convergence observatory (obs/converge.py, schema v8). `converge`:
+    # one record per evaluated frame / served request carrying its
+    # iteration-resolved convergence curve — `source` names the producer
+    # ("eval:<validator>" or "serve:<bucket>"), `iters` the iteration
+    # budget the curve covers, `idx` the strictly-increasing downsampled
+    # 0-based iteration indices (last one == iters-1), `residual` the mean
+    # |delta disparity| at each stored index. An `epe` curve (the in-graph
+    # low-res EPE proxy, recorded when GT was available), `bucket`
+    # ("HxW"), `id`/`frame`, `half_life` and `final_residual` ride along
+    # as extras. Consistency (lengths/monotonicity/finiteness) is linted
+    # by obs/validate.py check_converge_integrity. The v8 `slo` records
+    # additionally carry an optional `quality` extra: rolling per-bucket
+    # final-residual percentiles (serve quality-drift monitoring).
+    "converge": ("source", "iters", "idx", "residual"),
     "run_end": ("steps",),
 }
 

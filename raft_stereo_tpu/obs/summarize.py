@@ -209,6 +209,7 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "spans": _span_summary(events),
         "pipeline": _pipeline_gauges(events),
         "xla": _xla_summary(events),
+        "converge": _converge_summary(events),
         "compiles": {
             "count": len(by("compile")),
             "total_s": round(sum(e.get("duration_s", 0.0)
@@ -244,6 +245,38 @@ def _summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         out["memory_last"] = {k: last[k] for k in
                               ("bytes_in_use", "peak_bytes_in_use")
                               if k in last}
+    return out
+
+
+def _converge_summary(events: List[Dict[str, Any]]
+                      ) -> Optional[Dict[str, Any]]:
+    """Headlines from schema-v8 ``converge`` records (obs/converge.py):
+    curve count by source, half-life percentiles ("by which iteration had
+    the residual halved?") and final-residual percentiles — the quick read
+    before replaying the full decision table with ``cli converge``."""
+    curves = [e for e in events if e.get("event") == "converge"]
+    if not curves:
+        return None
+    from raft_stereo_tpu.obs.converge import _percentile
+    by_source: Dict[str, int] = {}
+    for c in curves:
+        src = str(c.get("source", "?"))
+        by_source[src] = by_source.get(src, 0) + 1
+    out: Dict[str, Any] = {
+        "count": len(curves),
+        "budget": max(int(c.get("iters", 0)) for c in curves),
+        "by_source": by_source,
+    }
+    hls = [float(c["half_life"]) for c in curves if "half_life" in c]
+    if hls:
+        out["half_life_p50"] = int(_percentile(hls, 50.0))
+        out["half_life_p95"] = int(_percentile(hls, 95.0))
+        out["n_half_life"] = len(hls)
+    finals = [float(c["final_residual"]) for c in curves
+              if "final_residual" in c]
+    if finals:
+        out["final_residual_p50"] = round(_percentile(finals, 50.0), 6)
+        out["final_residual_p95"] = round(_percentile(finals, 95.0), 6)
     return out
 
 
@@ -338,6 +371,23 @@ def format_summary(report: Dict[str, Any]) -> str:
             lines.append("")
             lines.append(f"xla executable ({xl.get('source')}): "
                          + "; ".join(parts))
+        cv = ev.get("converge")
+        if cv:
+            lines.append("")
+            srcs = ", ".join(f"{s}:{n}" for s, n in
+                             sorted(cv["by_source"].items()))
+            lines.append(f"convergence curves: {cv['count']} "
+                         f"(budget {cv['budget']} iters; {srcs})")
+            if "half_life_p50" in cv:
+                lines.append(f"  residual half-life: p50 iter "
+                             f"{cv['half_life_p50']}, p95 iter "
+                             f"{cv['half_life_p95']} "
+                             f"(n={cv['n_half_life']})")
+            if "final_residual_p50" in cv:
+                lines.append(f"  final residual: p50 "
+                             f"{cv['final_residual_p50']} px, p95 "
+                             f"{cv['final_residual_p95']} px — replay "
+                             f"exit thresholds with `cli converge`")
         c = ev["compiles"]
         lines.append("")
         lines.append(f"compiles: {c['count']} ({c['total_s']} s)")

@@ -49,6 +49,52 @@ def check_span_integrity(records: Iterable[dict]) -> List[str]:
     return errors
 
 
+def check_converge_integrity(records: Iterable[dict]) -> List[str]:
+    """Consistency of schema-v8 ``converge`` records (obs/converge.py).
+
+    The downsampled curve must be internally coherent or the early-exit
+    simulator silently lies: indices strictly increasing within the
+    iteration budget and ending on the final iteration, curves no longer
+    than the budget, residual/epe the same length as the index list, and
+    every value finite (a NaN residual means the aux read garbage).
+    """
+    import math
+    recs = [r for r in records
+            if isinstance(r, dict) and r.get("event") == "converge"]
+    errors: List[str] = []
+    for n, r in enumerate(recs):
+        tag = f"converge #{n} ({r.get('source')!r})"
+        idx, residual = r.get("idx"), r.get("residual")
+        iters = r.get("iters")
+        if not isinstance(idx, list) or not isinstance(residual, list) \
+                or not isinstance(iters, int):
+            errors.append(f"{tag}: idx/residual/iters malformed")
+            continue
+        if len(idx) != len(residual):
+            errors.append(f"{tag}: {len(idx)} indices vs "
+                          f"{len(residual)} residual values")
+        if len(idx) > iters:
+            errors.append(f"{tag}: {len(idx)} stored points exceed the "
+                          f"iteration budget {iters}")
+        if any(b <= a for a, b in zip(idx, idx[1:])):
+            errors.append(f"{tag}: downsample indices not strictly "
+                          f"increasing: {idx}")
+        if idx and (idx[0] < 0 or idx[-1] != iters - 1):
+            errors.append(f"{tag}: indices must cover [0, iters-1]; got "
+                          f"first={idx[0]} last={idx[-1]} iters={iters}")
+        epe = r.get("epe")
+        if epe is not None and (not isinstance(epe, list)
+                                or len(epe) != len(idx)):
+            errors.append(f"{tag}: epe curve length mismatch")
+        for name in ("residual", "epe"):
+            vals = r.get(name)
+            if isinstance(vals, list) and not all(
+                    isinstance(v, (int, float)) and math.isfinite(v)
+                    for v in vals):
+                errors.append(f"{tag}: non-finite {name} value")
+    return errors
+
+
 def check_path(path: str) -> List[str]:
     """Validate one ``events.jsonl`` (or a run directory containing one).
 
@@ -68,6 +114,7 @@ def check_path(path: str) -> List[str]:
         return [f"{path}: empty event log"]
     errors = validate_events(records)
     errors.extend(check_span_integrity(records))
+    errors.extend(check_converge_integrity(records))
     return [f"{path}: {e}" for e in errors]
 
 

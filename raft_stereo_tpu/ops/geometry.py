@@ -239,6 +239,15 @@ class InputPadder:
                for x in inputs]
         return out if len(out) > 1 else out[0]
 
+    def pad_zeros(self, *inputs: jax.Array):
+        """Like :meth:`pad` but zero-filled — for ground-truth/validity
+        planes, where edge replication would mark the padding as valid
+        signal (the iter-EPE aux masks pooled cells on exactly this)."""
+        l, r, t, b = self._pad
+        out = [jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0)),
+                       mode="constant") for x in inputs]
+        return out if len(out) > 1 else out[0]
+
     def unpad(self, x: jax.Array) -> jax.Array:
         l, r, t, b = self._pad
         ht, wd = x.shape[-3], x.shape[-2]

@@ -64,11 +64,17 @@ class ExecutableCache:
     """
 
     def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
-                 telemetry=None, aot: bool = True):
+                 telemetry=None, aot: bool = True, converge: bool = False):
         self.cfg = cfg
         self.model = create_model(cfg)
         self.telemetry = telemetry
         self.aot = aot
+        #: serve the converge flavor: the program additionally returns the
+        #: per-sample per-iteration |Δdisparity| curves (``(iters, B)``,
+        #: iter_metrics="per_sample") feeding the convergence observatory
+        #: and the SLO quality gauges. False keeps the exact 3-output
+        #: program of schema v7 (the --no_converge pin).
+        self.converge = converge
         self._lock = threading.Lock()
         self._entries: Dict[BucketKey, Any] = {}
         self._variables = variables
@@ -103,20 +109,27 @@ class ExecutableCache:
 
     def _build(self, key: BucketKey):
         model, iters = self.model, key.iters
+        converge = self.converge
+
+        def forward(variables, im1, im2, flow_init=None):
+            """(flow_lr, flow_up, finite[, deltas]) — the converge flavor
+            appends the per-sample convergence curves as a 4th output."""
+            metrics = "per_sample" if converge else False
+            out = model.apply(variables, im1, im2, iters=iters,
+                              flow_init=flow_init, test_mode=True,
+                              iter_metrics=metrics)
+            flow_lr, flow_up = out[0], out[1]
+            finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
+            if converge:
+                return flow_lr, flow_up, finite, out[2]
+            return flow_lr, flow_up, finite
 
         if key.warm:
             def run(variables, im1, im2, flow_init):
-                flow_lr, flow_up = model.apply(
-                    variables, im1, im2, iters=iters, flow_init=flow_init,
-                    test_mode=True)
-                finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
-                return flow_lr, flow_up, finite
+                return forward(variables, im1, im2, flow_init)
         else:
             def run(variables, im1, im2):
-                flow_lr, flow_up = model.apply(
-                    variables, im1, im2, iters=iters, test_mode=True)
-                finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
-                return flow_lr, flow_up, finite
+                return forward(variables, im1, im2)
 
         jitted = jax.jit(run)
         if not self.aot:
@@ -189,7 +202,9 @@ class ExecutableCache:
     def __call__(self, key: BucketKey, im1, im2,
                  flow_init: Optional[np.ndarray] = None):
         """Run the key's program with the CURRENT variables; returns
-        ``(flow_lowres, flow_up, finite_flags)`` device arrays."""
+        ``(flow_lowres, flow_up, finite_flags)`` device arrays — plus a
+        4th ``(iters, B)`` convergence-curve array when the cache was
+        built with ``converge=True``."""
         fn = self.get(key)
         variables = self.variables
         if key.warm:

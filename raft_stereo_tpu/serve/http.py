@@ -80,6 +80,20 @@ _PROM_METRICS = (
 )
 
 
+# per-bucket quality gauges (stats()["quality"], present when the server
+# runs with the convergence aux): rendered with a bucket label — the one
+# labeled metric family, so a scrape can alert on quality drift per shape
+# bucket (e.g. after a hot reload) without parsing the JSON rollup.
+_PROM_QUALITY = (
+    ("final_residual_p50", "raft_serve_final_residual_p50",
+     "Rolling p50 of the last-iteration mean |delta disparity| (px)"),
+    ("final_residual_p95", "raft_serve_final_residual_p95",
+     "Rolling p95 of the last-iteration mean |delta disparity| (px)"),
+    ("n", "raft_serve_quality_window_requests",
+     "Requests inside the rolling quality window"),
+)
+
+
 def prometheus_metrics(stats: dict) -> str:
     """Render a ``stats()`` dict as Prometheus text exposition format."""
     lines = []
@@ -92,6 +106,17 @@ def prometheus_metrics(stats: dict) -> str:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {float(value):g}")
+    quality = stats.get("quality") or {}
+    if quality:
+        for key, name, help_text in _PROM_QUALITY:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            for bucket in sorted(quality):
+                value = quality[bucket].get(key)
+                if value is None:
+                    continue
+                lines.append(f'{name}{{bucket="{bucket}"}} '
+                             f"{float(value):g}")
     return "\n".join(lines) + "\n"
 
 

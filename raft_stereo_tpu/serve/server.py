@@ -57,6 +57,7 @@ import numpy as np
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.inference import PAD_DIVIS, bucket_size
+from raft_stereo_tpu.obs.converge import emit as converge_emit
 from raft_stereo_tpu.obs.trace import NULL_TRACER
 from raft_stereo_tpu.ops.geometry import InputPadder
 from raft_stereo_tpu.serve.batching import (BoundedQueue, QueueClosed,
@@ -99,6 +100,11 @@ class ServeConfig:
     slo_every: int = 16
     #: latency sliding-window size for p50/p99 / sustained pairs/s
     slo_window: int = 256
+    #: serve the converge program flavor: per-request convergence curves
+    #: (`converge` events) + rolling per-bucket final-residual quality
+    #: gauges in the slo rollups / Prometheus metrics. False
+    #: (--no_converge) keeps the exact schema-v7 program and event stream.
+    converge: bool = True
 
 
 @dataclasses.dataclass
@@ -118,6 +124,8 @@ class ServeResult:
     queue_wait_s: float = 0.0
     batch_size: int = 0
     bucket: str = ""
+    #: last-iteration mean |Δdisparity| (converge aux; None when off)
+    final_residual: Optional[float] = None
 
     @property
     def disparity(self) -> Optional[np.ndarray]:
@@ -177,7 +185,8 @@ class StereoServer:
         self.serve = serve or ServeConfig()
         self.telemetry = telemetry
         self.cache = ExecutableCache(cfg, variables, telemetry=telemetry,
-                                     aot=self.serve.aot)
+                                     aot=self.serve.aot,
+                                     converge=self.serve.converge)
         self.slo = SLOTracker(telemetry, window=self.serve.slo_window,
                               emit_every=self.serve.slo_every)
         self._queue: BoundedQueue = BoundedQueue(self.serve.queue_depth)
@@ -407,11 +416,13 @@ class StereoServer:
     def _retire(self) -> None:
         group, padders, key, outputs = self._in_flight.popleft()
         try:
-            flow_lr, flow_up, finite = outputs
+            flow_lr, flow_up, finite, *aux = outputs
             # the host fetch — the device-completion sync point
             flow_lr = np.asarray(flow_lr)
             flow_up = np.asarray(flow_up)
             finite = np.asarray(finite)
+            # (iters, B) per-sample convergence curves (converge flavor)
+            deltas = np.asarray(aux[0]) if aux else None
         except Exception as exc:  # device-side execution error
             self._fail_group(group, key, exc, kind="dispatch")
             return
@@ -435,11 +446,19 @@ class StereoServer:
             if req.warm and req.stream is not None:
                 self._sessions[req.stream] = (flow_lr[j].shape,
                                               flow_lr[j])
+            final_residual = None
+            if deltas is not None:
+                final_residual = float(deltas[-1, j])
+                converge_emit(self.telemetry, f"serve:{key.label()}",
+                              deltas.shape[0], deltas[:, j],
+                              bucket=f"{key.height}x{key.width}",
+                              id=req.id)
             self._finish(req, ServeResult(
                 request_id=req.id, ok=True, flow=flow, stream=req.stream,
                 latency_s=now - req.t_submit,
                 queue_wait_s=req.t_dispatch - req.t_submit,
-                batch_size=len(group), bucket=key.label()))
+                batch_size=len(group), bucket=key.label(),
+                final_residual=final_residual))
 
     def _fail_group(self, group: List[_Request], key: BucketKey,
                     exc: BaseException, kind: str) -> None:
@@ -465,7 +484,8 @@ class StereoServer:
             latency_s=result.latency_s, queue_wait_s=result.queue_wait_s,
             bucket=result.bucket, batch_size=result.batch_size,
             in_flight=len(self._in_flight), stream=req.stream,
-            error=result.error, traceback_tail=result.traceback)
+            error=result.error, traceback_tail=result.traceback,
+            final_residual=result.final_residual)
         # the request's span tree, from the lifecycle stamps already taken:
         # queue_wait / collect_group / dispatch / retire tile the root
         # exactly (end = submit + the latency the client was told)

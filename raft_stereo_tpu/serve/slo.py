@@ -12,7 +12,11 @@ Three records ride the existing event bus (obs/telemetry.py):
 * ``slo`` — the serving headline every ``emit_every`` retirements: p50/p99
   end-to-end latency (ms) over a sliding sample window, current in-flight
   depth, and sustained pairs/s over the same window — the numbers a
-  million-user deployment would alert on.
+  million-user deployment would alert on. Since schema v8 the rollup also
+  carries a ``quality`` extra when the server runs with the convergence
+  aux: rolling per-bucket final-residual percentiles (how settled the
+  iteration actually is at retirement) — the gauge that makes quality
+  drift after a hot reload visible instead of silent.
 
 The tracker is lock-guarded (scheduler thread retires, client threads
 admit) and, like every telemetry path in this repo, fail-open: with
@@ -47,6 +51,9 @@ class SLOTracker:
         self._lock = threading.Lock()
         # (retire wall-clock, latency seconds) per retired request
         self._samples: "deque" = deque(maxlen=self.window)
+        # rolling final-residual window per bucket label (the serve
+        # quality gauges; fed only when the converge aux is on)
+        self._quality: Dict[str, "deque"] = {}
         self.admitted = 0
         self.completed = 0
         self.failed = 0
@@ -75,9 +82,12 @@ class SLOTracker:
                queue_wait_s: float, bucket: str, batch_size: int,
                in_flight: int, stream: Optional[str] = None,
                error: Optional[str] = None,
-               traceback_tail: Optional[str] = None) -> None:
+               traceback_tail: Optional[str] = None,
+               final_residual: Optional[float] = None) -> None:
         """Record one terminal request outcome; emits the ``request`` event
-        and, on cadence, the ``slo`` rollup."""
+        and, on cadence, the ``slo`` rollup. ``final_residual`` (mean
+        |Δdisparity| of the last refinement iteration, from the converge
+        aux) feeds the per-bucket rolling quality gauges."""
         now = time.monotonic()
         with self._lock:
             if status == "ok":
@@ -85,6 +95,11 @@ class SLOTracker:
             else:
                 self.failed += 1
             self._samples.append((now, float(latency_s)))
+            if final_residual is not None and status == "ok":
+                dq = self._quality.get(bucket)
+                if dq is None:
+                    dq = self._quality[bucket] = deque(maxlen=self.window)
+                dq.append(float(final_residual))
             self._retired_since_emit += 1
             do_slo = self._retired_since_emit >= self.emit_every
             if do_slo:
@@ -102,6 +117,8 @@ class SLOTracker:
                 payload["error"] = error
             if traceback_tail is not None:
                 payload["traceback"] = traceback_tail[-2000:]
+            if final_residual is not None:
+                payload["final_residual"] = round(float(final_residual), 6)
             self.telemetry.emit("request", **payload)
             if do_slo:
                 self.telemetry.emit("slo", **slo)
@@ -118,7 +135,7 @@ class SLOTracker:
                 if len(self._samples) > 1 else 0.0)
         pairs = len(self._samples)
         pps = pairs / span if span > 0 else 0.0
-        return {
+        snap = {
             "p50_ms": round(percentile(lats, 50) * 1e3, 3),
             "p99_ms": round(percentile(lats, 99) * 1e3, 3),
             "pairs_per_sec": round(pps, 4),
@@ -126,6 +143,18 @@ class SLOTracker:
             "window_requests": pairs,
             **self._counters(),
         }
+        if self._quality:
+            snap["quality"] = {
+                bucket: {
+                    "final_residual_p50": round(
+                        percentile(sorted(dq), 50), 6),
+                    "final_residual_p95": round(
+                        percentile(sorted(dq), 95), 6),
+                    "n": len(dq),
+                }
+                for bucket, dq in sorted(self._quality.items()) if dq
+            }
+        return snap
 
     def snapshot(self, in_flight: int = 0) -> Dict[str, Any]:
         """Current rollup (the ``/slo`` HTTP endpoint + loadtest report)."""

@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Convergence-observatory rehearsal: prove `cli converge` on real runs.
+
+The observatory's acceptance bar (r14) is not "the unit tests pass" — it
+is that the curves a real run leaves behind replay into the early-exit
+decision table without re-running the model:
+
+1. **eval leg** — a tiny CPU `cli eval --dataset things --stream on
+   --iter_epe` over a synthetic FlyingThings TEST tree (the fault_drill
+   fixture layout): every frame must leave a ``converge`` event carrying
+   both the residual and the in-graph EPE curve, and the run dir must
+   lint clean under schema v8.
+2. **serve leg** — a tiny `cli loadtest` (convergence aux on by
+   default): every served request must leave a ``converge`` event and
+   the slo rollups must carry the per-bucket quality gauges.
+3. **replay leg** — `cli converge <run_dir>` over BOTH run dirs must
+   exit 0 with a non-empty decision table; the eval table must carry
+   EPE-delta columns (the GT-backed what-if), the serve one residual
+   statistics per shape bucket.
+
+Each leg appends a dated JSON record to
+``runs/converge_drill/drills.jsonl``; exit non-zero if any check failed.
+Driven by scripts/rehearse_round.py's ``converge`` leg.
+
+Run: JAX_PLATFORMS=cpu python scripts/converge_drill.py [--keep-work]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT = os.path.join(REPO, "runs", "converge_drill")
+LOG = os.path.join(OUT, "drills.jsonl")
+
+CHILD_TIMEOUT_S = 900.0
+ITERS = 4
+
+
+def _run(cmd, env_extra=None, timeout=CHILD_TIMEOUT_S):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    # 1-device is plenty for the drill; drop any test-harness device forcing
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=timeout, env=env)
+    return proc.returncode, proc.stdout or ""
+
+
+def make_things_test_tree(root, n=4, h=48, w=64):
+    """FlyingThings TEST-split tree (validate_things reads finalpass/TEST;
+    same file layout as fault_drill.make_sceneflow_tree's TRAIN tree)."""
+    import numpy as np
+    from PIL import Image
+
+    from raft_stereo_tpu.data import frame_utils
+
+    rng = np.random.default_rng(0)
+    for side in ("left", "right"):
+        os.makedirs(os.path.join(root, "FlyingThings3D", "frames_finalpass",
+                                 "TEST", "A", "0000", side), exist_ok=True)
+    os.makedirs(os.path.join(root, "FlyingThings3D", "disparity", "TEST",
+                             "A", "0000", "left"), exist_ok=True)
+    for i in range(n):
+        for side in ("left", "right"):
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            Image.fromarray(img).save(os.path.join(
+                root, "FlyingThings3D", "frames_finalpass", "TEST", "A",
+                "0000", side, f"{i:04d}.png"))
+        frame_utils.write_pfm(
+            os.path.join(root, "FlyingThings3D", "disparity", "TEST", "A",
+                         "0000", "left", f"{i:04d}.pfm"),
+            rng.uniform(0.5, 8, (h, w)).astype(np.float32))
+
+
+def _curves(run_dir):
+    from raft_stereo_tpu.obs.events import read_events
+    records = read_events(os.path.join(run_dir, "events.jsonl"))
+    return records, [r for r in records if r.get("event") == "converge"]
+
+
+def _lint(run_dir):
+    from raft_stereo_tpu.obs.validate import check_path
+    return check_path(run_dir)
+
+
+def _replay(run_dir, expect_epe):
+    """`cli converge` over a recorded run; returns (errors, summary)."""
+    errors = []
+    rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
+                    "converge", run_dir, "--json"])
+    if rc != 0:
+        return [f"cli converge rc={rc}: {out.splitlines()[-1:]}"], None
+    try:
+        doc = json.loads(out[out.index("{"):])
+    except ValueError as e:
+        return [f"unparseable converge report: {e}"], None
+    if not doc.get("table"):
+        errors.append("decision table is empty")
+    if not doc.get("curves"):
+        errors.append("no curves replayed")
+    if expect_epe and not any(r.get("epe_delta_mean") is not None
+                              for r in doc.get("table", [])):
+        errors.append("eval table carries no epe_delta (GT curves missing)")
+    summary = {"curves": doc.get("curves"),
+               "rows": len(doc.get("table", [])),
+               "taus": doc.get("taus")}
+    return errors, summary
+
+
+def drill_eval(work):
+    make_things_test_tree(os.path.join(work, "data"))
+    run_dir = os.path.join(work, "runs", "eval")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "eval",
+        "--dataset", "things", "--data_root", os.path.join(work, "data"),
+        "--run_dir", run_dir, "--stream", "on", "--iter_epe",
+        "--valid_iters", str(ITERS),
+        "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "eval", "ok": False, "error": f"eval rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    errors = []
+    _, curves = _curves(run_dir)
+    if not curves:
+        errors.append("eval run emitted no converge events")
+    if not all("epe" in c for c in curves):
+        errors.append("--iter_epe eval curves missing the epe series")
+    lint = _lint(run_dir)
+    if lint:
+        errors.append(f"v8 lint: {lint[:3]}")
+    replay_errors, summary = _replay(run_dir, expect_epe=True)
+    errors.extend(replay_errors)
+    return {"drill": "eval", "ok": not errors, "run_dir": run_dir,
+            "frames": len(curves), "replay": summary,
+            "error": "; ".join(errors) or None}
+
+
+def drill_serve(work):
+    run_dir = os.path.join(work, "loadtest")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "loadtest",
+        "--run_dir", run_dir, "--no_baseline", "--no_progress",
+        "--shapes", "48x96", "64x128",
+        "--clients", "3", "--requests_per_client", "2",
+        "--video_streams", "0", "--max_batch", "2", "--window", "2",
+        "--iters", str(ITERS), "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "serve", "ok": False, "error": f"loadtest rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    serve_dir = os.path.join(run_dir, "serve")
+    errors = []
+    records, curves = _curves(serve_dir)
+    n_ok = sum(1 for r in records
+               if r.get("event") == "request" and r.get("status") == "ok")
+    if not curves:
+        errors.append("serve run emitted no converge events")
+    elif len(curves) != n_ok:
+        errors.append(f"{len(curves)} converge events != {n_ok} ok requests")
+    if not any(e.get("event") == "slo" and "quality" in e for e in records):
+        errors.append("no slo rollup carries the quality gauges")
+    lint = _lint(serve_dir)
+    if lint:
+        errors.append(f"v8 lint: {lint[:3]}")
+    replay_errors, summary = _replay(serve_dir, expect_epe=False)
+    errors.extend(replay_errors)
+    return {"drill": "serve", "ok": not errors, "run_dir": serve_dir,
+            "requests": n_ok, "replay": summary,
+            "error": "; ".join(errors) or None}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="convergence-observatory rehearsal over real tiny runs "
+                    "(see module doc)")
+    p.add_argument("--keep-work", action="store_true",
+                   help="keep the scratch tree (default: delete on exit)")
+    args = p.parse_args(argv)
+
+    from raft_stereo_tpu.obs.events import append_json_log
+
+    os.makedirs(OUT, exist_ok=True)
+    work = tempfile.mkdtemp(prefix="converge_drill_")
+    t0 = time.monotonic()
+    try:
+        records = [drill_eval(work), drill_serve(work)]
+    finally:
+        if args.keep_work:
+            print(f"work tree kept: {work}")
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+    ok = True
+    for rec in records:
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        append_json_log(LOG, rec, stream=sys.stderr)
+        ok = ok and rec["ok"]
+    print(("CONVERGE DRILL ok: " if ok else "CONVERGE DRILL FAILED: ")
+          + ", ".join(f"{r['drill']}={'ok' if r['ok'] else 'FAIL'}"
+                      for r in records))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,13 @@ rehearsal:
   request's wall time covered by named child spans, and ``cli doctor``
   exit 0 with a non-UNKNOWN verdict. The span instrumentation earns its
   keep on real runs, not just in tests/test_trace.py.
+* **converge** — the convergence-observatory rehearsal (r14): ``python
+  scripts/converge_drill.py`` — a tiny ``cli eval --stream on
+  --iter_epe`` and a tiny ``cli loadtest`` must each leave schema-v8
+  ``converge`` curves that lint clean, and ``cli converge <run_dir>``
+  must replay them into a non-empty early-exit decision table
+  (EPE-delta columns on the GT-backed eval leg) without re-running the
+  model.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
@@ -215,16 +222,17 @@ def main(argv=None):
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace"],
+                            "serve", "trace", "converge"],
                    choices=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace"])
+                            "serve", "trace", "converge"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
     p.add_argument("--fault-budget", type=float, default=1800.0)
     p.add_argument("--serve-budget", type=float, default=1800.0)
     p.add_argument("--trace-budget", type=float, default=1800.0)
+    p.add_argument("--converge-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -293,6 +301,12 @@ def main(argv=None):
             [sys.executable, os.path.join(REPO, "scripts",
                                           "trace_drill.py")],
             args.trace_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "converge" in args.legs:
+        records.append(run_leg(
+            "converge",
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "converge_drill.py")],
+            args.converge_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

@@ -1,0 +1,638 @@
+"""The convergence observatory (obs/converge.py + schema v8):
+
+* in-graph per-iteration EPE aux vs a NumPy oracle on a seeded frame,
+  and the per_sample/batch-mean consistency of the residual curves;
+* event emission + v8 lint across the eval paths (sequential and
+  streaming) with a real tiny predictor, and across the serve retire
+  path (converge events, slo quality rollup, Prometheus gauges);
+* the early-exit simulator's math pinned on hand-built curves
+  (downsample/exit_iter/simulate/decision_table/exit_percentile);
+* the OVER_ITERATED doctor verdict on a seeded log, plus its negative
+  case;
+* the --no_converge zero-overhead pin: converge-off predictors keep the
+  exact prior HLO and a same-seed double run emits an identical event
+  stream; converge-on flows stay bitwise-equal to converge-off ones;
+* schema v8 is additive: v1-v7-stamped records still validate, a
+  v7-stamped converge record flags drift, and the converge lint catches
+  malformed curves;
+* cli-drift rule v5: the build_converge_parser surface fires on a
+  seeded orphan flag.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.eval.stream import StreamConfig, run_frames
+from raft_stereo_tpu.inference import StereoPredictor
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.obs import Telemetry, read_events
+from raft_stereo_tpu.obs import converge as cv
+from raft_stereo_tpu.obs.events import make_record, validate_record
+from raft_stereo_tpu.obs.validate import (check_converge_integrity,
+                                          check_path)
+
+REPO = Path(__file__).resolve().parents[1]
+
+H, W = 32, 64          # /32-exact so model-level oracles need no padding
+ITERS = 3
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, H, W, 3))
+    return cfg, model, variables
+
+
+# module-scoped predictors: the compiled flavors are shared across tests
+# (each StereoPredictor carries its own jit cache, and tier-1 wall time is
+# dominated by tiny-model compiles, not by the work itself)
+
+@pytest.fixture(scope="module")
+def pred_on(tiny):
+    cfg, _, variables = tiny
+    return StereoPredictor(cfg, variables, valid_iters=ITERS, iter_epe=True)
+
+
+@pytest.fixture(scope="module")
+def pred_off(tiny):
+    cfg, _, variables = tiny
+    return StereoPredictor(cfg, variables, valid_iters=ITERS)
+
+
+def _frame(seed, h=H, w=W):
+    rng = np.random.default_rng(seed)
+    left = rng.integers(0, 255, (h, w, 3)).astype(np.float32)
+    right = rng.integers(0, 255, (h, w, 3)).astype(np.float32)
+    flow = -np.abs(rng.normal(4.0, 1.0, (h, w, 1))).astype(np.float32)
+    valid = np.ones((h, w, 1), np.float32)
+    valid[: h // 4] = 0.0      # a masked-out band exercises the pooling
+    return {"image1": left, "image2": right, "flow": flow, "valid": valid}
+
+
+class _GTData:
+    """Stub dataset with GT flow for run_frames."""
+
+    def __init__(self, n=3, h=H, w=W, seed=0):
+        self._samples = [_frame(seed + i, h, w) for i in range(n)]
+
+    def __len__(self):
+        return len(self._samples)
+
+    def sample(self, i):
+        return self._samples[i]
+
+
+def _oracle_epe(flow_lr, flow_gt, valid, factor):
+    """NumPy twin of the model's pooled low-res EPE aux for one batch."""
+    b, h, w, _ = flow_lr.shape
+    gt = flow_gt[..., 0]
+    m = valid[..., 0]
+    gt_c = gt.reshape(b, h, factor, w, factor)
+    m_c = m.reshape(b, h, factor, w, factor)
+    msum = m_c.sum(axis=(2, 4))
+    gt_pool = (gt_c * m_c).sum(axis=(2, 4)) / np.maximum(msum, 1.0)
+    cell_valid = (msum > 0).astype(np.float64)
+    denom = np.maximum(cell_valid.sum(axis=(1, 2)), 1.0)
+    err = np.abs(flow_lr[..., 0] * factor - gt_pool)
+    return (err * cell_valid).sum(axis=(1, 2)) / denom
+
+
+# ------------------------------------------------ in-graph aux vs oracle
+
+def test_iter_epe_aux_matches_numpy_oracle(tiny):
+    cfg, model, variables = tiny
+    s = _frame(7)
+    im = s["image1"][None]
+    out = model.apply(variables, im, s["image2"][None], iters=ITERS,
+                      test_mode=True, iter_metrics="per_sample",
+                      flow_gt=s["flow"][None], loss_mask=s["valid"][None])
+    flow_lr, flow_up, deltas, epes = out
+    assert deltas.shape == (ITERS, 1) and epes.shape == (ITERS, 1)
+    assert np.all(np.isfinite(np.asarray(epes)))
+    oracle = _oracle_epe(np.asarray(flow_lr, np.float64),
+                         s["flow"][None].astype(np.float64),
+                         s["valid"][None].astype(np.float64), cfg.factor)
+    np.testing.assert_allclose(np.asarray(epes)[-1], oracle,
+                               rtol=1e-4, atol=1e-5)
+    # the aux rides along without perturbing the prediction
+    _, up_plain = model.apply(variables, im, s["image2"][None], iters=ITERS,
+                              test_mode=True)
+    np.testing.assert_array_equal(np.asarray(up_plain), np.asarray(flow_up))
+
+
+def test_per_sample_curves_consistent_with_batch_mean(tiny):
+    cfg, model, variables = tiny
+    a, b = _frame(1), _frame(2)
+    im1 = np.stack([a["image1"], b["image1"]])
+    im2 = np.stack([a["image2"], b["image2"]])
+    gt = np.stack([a["flow"], b["flow"]])
+    va = np.stack([a["valid"], b["valid"]])
+    kw = dict(iters=ITERS, test_mode=True, flow_gt=gt, loss_mask=va)
+    _, _, d_ps, e_ps = model.apply(variables, im1, im2,
+                                   iter_metrics="per_sample", **kw)
+    _, _, d_mean, e_mean = model.apply(variables, im1, im2,
+                                       iter_metrics=True, **kw)
+    assert d_ps.shape == (ITERS, 2) and d_mean.shape == (ITERS,)
+    np.testing.assert_allclose(np.asarray(d_ps).mean(axis=1),
+                               np.asarray(d_mean), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(e_ps).mean(axis=1),
+                               np.asarray(e_mean), rtol=1e-5, atol=1e-6)
+
+
+def test_gt_without_iter_metrics_is_loud(tiny):
+    _, model, variables = tiny
+    s = _frame(3)
+    with pytest.raises(ValueError, match="iter_metrics"):
+        model.apply(variables, s["image1"][None], s["image2"][None],
+                    iters=2, test_mode=True, flow_gt=s["flow"][None])
+
+
+# ------------------------------------------------- simulator math pins
+
+def test_downsample_keeps_endpoints_strictly_increasing():
+    vals = list(np.linspace(1.0, 0.0, 50))
+    idx, res = cv.downsample(vals, max_points=8)
+    assert len(idx) <= 8 and idx[0] == 0 and idx[-1] == 49
+    assert all(b > a for a, b in zip(idx, idx[1:]))
+    assert res == [vals[i] for i in idx]
+    # short curves come back whole
+    idx, res = cv.downsample([3.0, 2.0], max_points=8)
+    assert idx == [0, 1] and res == [3.0, 2.0]
+    assert cv.downsample([], 4) == ([], [])
+
+
+def test_half_life_and_payload():
+    payload = cv.converge_payload("eval:t", 4, [1.0, 0.4, 0.1, 0.02],
+                                  epe=[2.0, 1.5, 1.2, 1.1], bucket="32x64")
+    assert payload["idx"] == [0, 1, 2, 3]
+    assert payload["half_life"] == 1          # 0.4 <= 1.0 / 2
+    assert payload["final_residual"] == 0.02
+    assert payload["epe"] == [2.0, 1.5, 1.2, 1.1]
+    rec = make_record("converge", t=1.0, **payload)
+    assert validate_record(rec) == []
+    assert check_converge_integrity([rec]) == []
+
+
+def test_simulate_pins_on_hand_built_curve():
+    rec = {"iters": 4, "idx": [0, 1, 2, 3],
+           "residual": [1.0, 0.4, 0.1, 0.02], "epe": [2.0, 1.5, 1.2, 1.1]}
+    assert cv.exit_iter(rec["idx"], rec["residual"], 0.5) == 2
+    assert cv.exit_iter(rec["idx"], rec["residual"], 0.01) is None
+    s = cv.simulate(rec, 0.5)
+    assert s == {"converged": True, "exit_iter": 2, "saved": 2,
+                 "epe_delta": pytest.approx(0.4)}
+    s = cv.simulate(rec, 0.01)     # never converges: full budget, no delta
+    assert s == {"converged": False, "exit_iter": 4, "saved": 0,
+                 "epe_delta": pytest.approx(0.0)}
+
+
+def test_decision_table_and_exit_percentile():
+    fast = {"iters": 8, "idx": [0, 3, 7], "residual": [1.0, 0.04, 0.01],
+            "source": "eval:things", "bucket": "32x64"}
+    slow = {"iters": 8, "idx": [0, 3, 7], "residual": [1.0, 0.5, 0.2],
+            "source": "eval:things", "bucket": "64x128"}
+    recs = [fast] * 3 + [slow]
+    ev = cv.exit_percentile(recs, tau=0.05, q=95.0)
+    # the never-converged curve counts as the full budget
+    assert ev["budget"] == 8 and ev["exit_iter"] == 8
+    assert ev["n"] == 4 and ev["n_converged"] == 3
+    assert cv.exit_percentile([fast] * 4, tau=0.05)["exit_iter"] == 4
+    assert cv.exit_percentile([], tau=0.05) is None
+    rows = cv.decision_table(recs, taus=(0.05,), bucket_by="both")
+    by_bucket = {r["bucket"]: r for r in rows}
+    assert set(by_bucket) == {"32x64", "64x128", "*"}
+    assert by_bucket["32x64"]["converged_frac"] == 1.0
+    assert by_bucket["32x64"]["exit_p50"] == 4
+    assert by_bucket["32x64"]["saved_mean"] == 4.0
+    assert by_bucket["64x128"]["converged_frac"] == 0.0
+    assert by_bucket["*"]["n"] == 4
+    assert by_bucket["*"]["epe_delta_mean"] is None   # no epe curves
+    only_all = cv.decision_table(recs, taus=(0.05,), bucket_by="all")
+    assert {r["bucket"] for r in only_all} == {"*"}
+    assert "saved" in cv.format_table(rows)
+
+
+# --------------------------------------------------- converge lint (v8)
+
+def test_converge_lint_catches_malformed_curves():
+    def rec(**kw):
+        base = dict(source="eval:t", iters=4, idx=[0, 1, 2, 3],
+                    residual=[1.0, 0.4, 0.1, 0.02])
+        base.update(kw)
+        return make_record("converge", t=1.0, **base)
+
+    assert check_converge_integrity([rec()]) == []
+    assert any("residual values" in e for e in check_converge_integrity(
+        [rec(residual=[1.0, 0.4])]))
+    assert any("strictly increasing" in e for e in check_converge_integrity(
+        [rec(idx=[0, 2, 1, 3])]))
+    assert any("cover [0, iters-1]" in e for e in check_converge_integrity(
+        [rec(idx=[0, 1, 2, 2])]))   # last != iters-1 (also non-monotone)
+    assert any("exceed the iteration budget" in e
+               for e in check_converge_integrity(
+                   [rec(iters=2, idx=[0, 1, 2, 3])]))
+    assert any("non-finite residual" in e for e in check_converge_integrity(
+        [rec(residual=[1.0, float("nan"), 0.1, 0.02])]))
+    assert any("epe curve length" in e for e in check_converge_integrity(
+        [rec(epe=[1.0])]))
+    assert any("malformed" in e for e in check_converge_integrity(
+        [rec(idx="nope")]))
+
+
+def test_schema_v8_additive_and_v7_stamp_is_drift():
+    good = make_record("converge", t=1.0, source="eval:t", iters=4,
+                       idx=[0, 3], residual=[1.0, 0.1])
+    assert validate_record(good) == []
+    stale = dict(good, schema=7)
+    assert any("introduced in schema 8" in e for e in validate_record(stale))
+    missing = {k: v for k, v in good.items() if k != "idx"}
+    assert any("idx" in e for e in validate_record(missing))
+    # pre-v8 records validate against their own surface (additive bump)
+    for ver, event, payload in [
+            (1, "step", dict(step=1, data_wait_s=0.1, dispatch_s=0.1,
+                             fetch_s=0.1)),
+            (5, "anomaly", dict(kind="nonfinite_grad")),
+            (6, "slo", dict(p50_ms=1.0, p99_ms=2.0, pairs_per_sec=3.0,
+                            in_flight=1)),
+            (7, "span", dict(name="x", span_id="s1", trace_id="t1",
+                             start_s=0.0, dur_s=0.1))]:
+        rec = dict(make_record(event, t=1.0, **payload), schema=ver)
+        assert validate_record(rec) == [], (ver, event)
+    # the v8 slo quality extra rides along without a required-field change
+    slo = make_record("slo", t=1.0, p50_ms=1.0, p99_ms=2.0,
+                      pairs_per_sec=3.0, in_flight=1,
+                      quality={"32x64": {"final_residual_p50": 0.01,
+                                         "final_residual_p95": 0.02,
+                                         "n": 4}})
+    assert validate_record(slo) == []
+
+
+def test_checked_in_artifacts_still_lint_clean_under_v8():
+    import glob as globmod
+    olds = sorted(globmod.glob(str(REPO / "runs" / "**" / "events.jsonl"),
+                               recursive=True))
+    for path in olds:
+        assert check_path(path) == [], path
+
+
+# --------------------------------------- eval paths: emission + v8 lint
+
+def _eval_run(tmp_path, name, ds, predictor, stream, **kw):
+    tel = Telemetry(str(tmp_path / name), stall_deadline_s=None)
+    tel.run_start(config={"mode": "eval"})
+    run_frames(predictor, ds, lambda *a: None, iters=ITERS,
+               stream=stream, telemetry=tel, source="things", **kw)
+    tel.emit("run_end", steps=tel.steps, ok=True)
+    tel.close()
+    return read_events(str(tmp_path / name / "events.jsonl"))
+
+
+def test_eval_emits_converge_events_both_paths(tmp_path, pred_on):
+    ds = _GTData(n=3)
+    predictor = pred_on
+    assert predictor.converge    # iter_epe implies the residual aux
+    seq = _eval_run(tmp_path, "seq", ds, predictor, stream=False)
+    st = _eval_run(tmp_path, "stream", ds, predictor,
+                   stream=StreamConfig(enabled=True, window=2, microbatch=2))
+    for name, events in (("seq", seq), ("stream", st)):
+        curves = [e for e in events if e.get("event") == "converge"]
+        assert len(curves) == 3, name
+        for c in curves:
+            assert c["source"] == "eval:things"
+            assert c["bucket"] == f"{H}x{W}"
+            assert c["iters"] == ITERS and len(c["idx"]) == ITERS
+            assert len(c["epe"]) == ITERS      # GT dataset -> epe rides
+            assert "frame" in c and "final_residual" in c
+        assert check_path(str(tmp_path / name)) == []
+    # the recorded run feeds the simulator end to end
+    rows = cv.decision_table(cv.load_records(str(tmp_path / "stream")),
+                             taus=(1e9,), bucket_by="all")
+    assert rows and rows[0]["n"] == 3 and rows[0]["converged_frac"] == 1.0
+    assert rows[0]["n_epe"] == 3
+
+
+def test_converge_without_gt_and_stub_predictors(tmp_path, tiny):
+    """converge=True alone (no iter_epe) records residual-only curves; a
+    GT-less sample set never sees gt kwargs; stub predictors without the
+    aux API emit nothing."""
+    cfg, _, variables = tiny
+    ds = _GTData(n=2)
+    predictor = StereoPredictor(cfg, variables, valid_iters=ITERS,
+                                converge=True)
+    events = _eval_run(tmp_path, "nogt", ds, predictor, stream=False)
+    curves = [e for e in events if e.get("event") == "converge"]
+    assert len(curves) == 2 and all("epe" not in c for c in curves)
+    assert check_path(str(tmp_path / "nogt")) == []
+
+    class _Stub:
+        def __call__(self, im1, im2, iters, **kw):
+            assert not kw          # no gt kwargs leak to stub predictors
+            return np.zeros((im1.shape[0],) + im1.shape[1:3] + (1,),
+                            np.float32)
+
+    events = _eval_run(tmp_path, "stub", ds, _Stub(), stream=False)
+    assert [e for e in events if e.get("event") == "converge"] == []
+
+
+def test_no_converge_is_zero_overhead(tmp_path, tiny, pred_off, pred_on):
+    """The --no_converge pin: converge-off keeps the exact prior HLO, a
+    same-seed double run emits an identical event stream (modulo wall
+    clock), and converge-on flows are bitwise-equal to converge-off."""
+    cfg, model, variables = tiny
+    ds = _GTData(n=2)
+    off1 = off2 = pred_off
+    on = pred_on
+    ev1 = _eval_run(tmp_path, "off1", ds, off1, stream=False)
+    ev2 = _eval_run(tmp_path, "off2", ds, off2, stream=False)
+
+    def scrub(events):
+        # compile events depend on the process-level jit cache (the first
+        # run pays for shared helpers), and the wall-clock/run-name fields
+        # differ by construction — the semantic stream must not
+        return [{k: v for k, v in e.items()
+                 if k not in ("t", "ts", "run", "path", "data_wait_s",
+                              "dispatch_s", "fetch_s")}
+                for e in events if e.get("event") != "compile"]
+
+    assert scrub(ev1) == scrub(ev2)
+    assert [e for e in ev1 if e.get("event") == "converge"] == []
+    assert off1.take_aux() is None
+    # numerics: the aux never perturbs the flow
+    s = ds.sample(0)
+    flow_off = off1(s["image1"][None], s["image2"][None], ITERS)
+    flow_on = on(s["image1"][None], s["image2"][None], ITERS,
+                 flow_gt=s["flow"][None], valid=s["valid"][None])
+    np.testing.assert_array_equal(flow_off, flow_on)
+    aux = on.take_aux()
+    assert set(aux) == {"residual", "epe"}
+    assert aux["residual"].shape == (ITERS, 1)
+    assert on.take_aux() is None          # popped once
+    # HLO pin: the converge-off program IS the prior plain-test_mode one
+    spec = jax.ShapeDtypeStruct((1, H, W, 3), np.float32)
+    vspec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), variables)
+
+    def run_off(v, a, b):
+        return model.apply(v, a, b, iters=ITERS, test_mode=True,
+                           iter_metrics=False, flow_gt=None)
+
+    def run_prior(v, a, b):
+        return model.apply(v, a, b, iters=ITERS, test_mode=True)
+
+    run_off.__name__ = run_prior.__name__ = "forward"   # same module name
+    text_off = jax.jit(run_off).lower(vspec, spec, spec).as_text()
+    text_prior = jax.jit(run_prior).lower(vspec, spec, spec).as_text()
+    assert text_off == text_prior
+
+
+def test_predict_async_carries_aux_on_handle(pred_on, pred_off):
+    predictor = pred_on
+    s = _frame(9)
+    handle = predictor.predict_async(
+        s["image1"][None], s["image2"][None], ITERS,
+        flow_gt=s["flow"][None], valid=s["valid"][None])
+    flow = handle.result()
+    aux = handle.aux_result()
+    assert flow.shape == (1, H, W, 1)
+    assert set(aux) == {"residual", "epe"}
+    assert aux["residual"].shape == (ITERS, 1)
+    assert handle.aux_result() is aux     # fetched once, then cached
+    # converge-off handles carry no aux
+    assert pred_off.predict_async(
+        s["image1"][None], s["image2"][None], ITERS).aux_result() is None
+
+
+# ------------------------------------------------- doctor: OVER_ITERATED
+
+def _seeded_converge_log(tmp_path, exit_at, budget=22, n=8):
+    """A run dir whose curves all settle below DOCTOR_TAU at exit_at."""
+    run = tmp_path / "run"
+    tel = Telemetry(str(run), stall_deadline_s=None)
+    tel.run_start(config={})
+    for i in range(n):
+        residual = [1.0 if k < exit_at else cv.DOCTOR_TAU / 2
+                    for k in range(budget)]
+        cv.emit(tel, "eval:things", budget, residual,
+                bucket="32x64", frame=i)
+    tel.emit("run_end", steps=n, ok=True)
+    tel.close()
+    return str(run)
+
+
+def test_doctor_over_iterated_verdict_with_evidence(tmp_path, capsys):
+    from raft_stereo_tpu.obs.doctor import diagnose, main
+    run = _seeded_converge_log(tmp_path, exit_at=7)
+    report = diagnose(run)
+    v = next(v for v in report["verdicts"] if v["phase"] == "converge")
+    assert v["verdict"] == "OVER_ITERATED"
+    assert any("p95 converged by iter 8 of 22" in e for e in v["evidence"])
+    assert any("cli converge" in e for e in v["evidence"])
+    assert main([run, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert any(x["verdict"] == "OVER_ITERATED" for x in out["verdicts"])
+
+
+def test_doctor_over_iterated_negative_cases(tmp_path):
+    from raft_stereo_tpu.obs.doctor import diagnose
+    # exits at the budget edge: inside the margin, no verdict
+    run = _seeded_converge_log(tmp_path / "edge", exit_at=21)
+    assert all(v["verdict"] != "OVER_ITERATED"
+               for v in diagnose(run)["verdicts"])
+    # too few curves: no verdict
+    run = _seeded_converge_log(tmp_path / "few", exit_at=7, n=2)
+    assert all(v["verdict"] != "OVER_ITERATED"
+               for v in diagnose(run)["verdicts"])
+
+
+# ----------------------------------------- serve: quality gauges + events
+
+class _Fake4Cache:
+    """Fake converge-flavor executable: 4 outputs incl. (iters, B) curves."""
+
+    def __len__(self):
+        return 1
+
+    def __call__(self, key, im1, im2, flow_init=None):
+        b, h, w, _ = im1.shape
+        deltas = np.linspace(1.0, 0.01, key.iters)[:, None].repeat(b, 1)
+        return (np.zeros((b, h // 4, w // 4, 2), np.float32),
+                np.full((b, h, w, 1), 7.0, np.float32),
+                np.ones((b,), bool),
+                deltas.astype(np.float32))
+
+
+def _serve_run(tmp_path, name, cache):
+    from raft_stereo_tpu.serve import ServeConfig, StereoServer
+    tel = Telemetry(str(tmp_path / name), stall_deadline_s=None)
+    tel.run_start(config={"mode": "serve"})
+    stub_vars = {"params": {"w": np.zeros((1,), np.float32)}}
+    server = StereoServer(
+        RAFTStereoConfig(), stub_vars,
+        ServeConfig(max_batch=2, window=2, default_iters=4, linger_s=0.0,
+                    slo_every=1),
+        telemetry=tel, autostart=False)
+    server.cache = cache
+    server.start()
+    rng = np.random.default_rng(0)
+    results = []
+    for i in range(3):
+        left = rng.random((H, W, 3)).astype(np.float32)
+        right = rng.random((H, W, 3)).astype(np.float32)
+        results.append(server.submit(left, right).result(timeout=60))
+    server.request_drain()
+    assert server.join(timeout=60)
+    stats = server.stats()
+    tel.emit("run_end", steps=3, ok=True)
+    tel.close()
+    return results, stats, read_events(str(tmp_path / name /
+                                           "events.jsonl"))
+
+
+def test_serve_converge_events_and_quality_rollup(tmp_path):
+    from raft_stereo_tpu.serve.http import prometheus_metrics
+    results, stats, events = _serve_run(tmp_path, "serve", _Fake4Cache())
+    assert all(r.ok for r in results)
+    assert all(r.final_residual == pytest.approx(0.01) for r in results)
+    curves = [e for e in events if e.get("event") == "converge"]
+    assert len(curves) == 3
+    for c in curves:
+        assert c["source"].startswith("serve:")
+        assert c["iters"] == 4 and c["idx"][-1] == 3
+        assert c["bucket"].count("x") == 1 and c["id"].startswith("r")
+    reqs = [e for e in events if e.get("event") == "request"]
+    assert all(r["final_residual"] == pytest.approx(0.01) for r in reqs)
+    # the slo rollup carries the per-bucket quality gauges
+    (bucket, q), = stats["quality"].items()
+    assert q["n"] == 3
+    assert q["final_residual_p50"] == pytest.approx(0.01)
+    assert q["final_residual_p95"] == pytest.approx(0.01)
+    slo = [e for e in events if e.get("event") == "slo"]
+    assert any("quality" in e for e in slo)
+    assert check_path(str(tmp_path / "serve")) == []
+    # Prometheus exposition renders the labeled quality gauges
+    text = prometheus_metrics(stats)
+    assert f'raft_serve_final_residual_p50{{bucket="{bucket}"}}' in text
+    assert f'raft_serve_quality_window_requests{{bucket="{bucket}"}} 3' \
+        in text
+
+
+def test_serve_no_converge_emits_nothing_extra(tmp_path):
+    """A 3-output program (the --no_converge flavor) leaves the stream
+    exactly as schema v7 had it: no converge events, no final_residual,
+    no quality rollup — and a same-seed double run pins the identical
+    request stream."""
+    from raft_stereo_tpu.serve.http import prometheus_metrics
+    from test_serve import _FakeCache
+
+    def run(name):
+        results, stats, events = _serve_run(tmp_path, name, _FakeCache())
+        assert all(r.ok and r.final_residual is None for r in results)
+        assert [e for e in events if e.get("event") == "converge"] == []
+        assert "quality" not in stats
+        assert all("final_residual" not in e for e in events
+                   if e.get("event") == "request")
+        assert "final_residual" not in prometheus_metrics(stats)
+        return events
+
+    a, b = run("off_a"), run("off_b")
+
+    def scrub(events):
+        drop = ("t", "ts", "run", "path", "latency_s", "queue_wait_s",
+                "p50_ms", "p99_ms", "pairs_per_sec", "batch_size",
+                "in_flight", "depth")
+        return [{k: v for k, v in e.items() if k not in drop}
+                for e in events if e.get("event") != "compile"]
+
+    assert scrub(a) == scrub(b)
+
+
+def test_serve_config_and_cache_default_flavors():
+    from raft_stereo_tpu.serve import ServeConfig
+    from raft_stereo_tpu.serve.cache import ExecutableCache
+    assert ServeConfig().converge is True       # serving records by default
+    stub = {"params": {"w": np.zeros((1,), np.float32)}}
+    assert ExecutableCache(RAFTStereoConfig(), stub).converge is False
+
+
+# ------------------------------------------------- cli surfaces + lint
+
+def test_build_converge_parser_defaults():
+    from raft_stereo_tpu.cli import build_converge_parser
+    args = build_converge_parser().parse_args(["runs/x"])
+    assert args.run_dir == "runs/x"
+    assert args.taus is None and args.bucket_by == "both"
+    assert not args.json and args.out is None
+    args = build_converge_parser().parse_args(
+        ["runs/x", "--taus", "0.5", "0.1", "--bucket_by", "all", "--json"])
+    assert args.taus == [0.5, 0.1] and args.bucket_by == "all"
+
+
+def test_eval_serve_parsers_carry_converge_flags():
+    from raft_stereo_tpu.cli import (build_eval_parser, build_serve_parser,
+                                     serve_config)
+    args = build_eval_parser().parse_args(["--dataset", "things"])
+    assert not args.no_converge and not args.iter_epe
+    args = build_serve_parser().parse_args(["--no_converge"])
+    assert serve_config(args).converge is False
+    args = build_serve_parser().parse_args([])
+    assert serve_config(args).converge is True
+
+
+def test_cli_converge_main_on_recorded_run(tmp_path, capsys):
+    from raft_stereo_tpu.cli import main
+    run = tmp_path / "run"
+    tel = Telemetry(str(run), stall_deadline_s=None)
+    tel.run_start(config={})
+    for i in range(4):
+        cv.emit(tel, "eval:things", 8,
+                [1.0, 0.5, 0.2, 0.1, 0.04, 0.03, 0.02, 0.01],
+                epe=[2.0] * 7 + [1.0], bucket="32x64", frame=i)
+    tel.emit("run_end", steps=4, ok=True)
+    tel.close()
+    out_json = tmp_path / "table.json"
+    assert main(["converge", str(run), "--json",
+                 "--out", str(out_json)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["curves"] == 4 and doc["table"]
+    assert doc["taus"] == list(cv.DEFAULT_TAUS)
+    assert json.loads(out_json.read_text())["table"] == doc["table"]
+    # empty run dir: loud exit 1
+    assert main(["converge", str(tmp_path / "empty")]) == 1
+    assert "no converge records" in capsys.readouterr().err
+    # the command is advertised
+    assert main([]) == 2
+
+
+def test_cli_drift_v5_fires_on_seeded_converge_fixture(tmp_path):
+    """Rule v5: an orphan flag on the converge surface is an error; flags
+    the obs/converge.py consumer reads stay clean."""
+    from raft_stereo_tpu.analysis.ast_rules import (
+        RULE_VERSIONS, check_entry_surface_drift)
+
+    assert RULE_VERSIONS["cli-drift"] == 5
+    pkg = tmp_path / "raft_stereo_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "cli.py").write_text(
+        "def build_converge_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('run_dir')\n"
+        "    p.add_argument('--taus')\n"
+        "    p.add_argument('--converge_orphan')\n"
+        "    return p\n")
+    (pkg / "obs" / "converge.py").write_text(
+        "def main(args):\n"
+        "    return (args.run_dir, args.taus)\n")
+    findings = check_entry_surface_drift(str(tmp_path))
+    errors = [f for f in findings
+              if f.rule == "cli-drift" and f.severity == "error"]
+    assert {f.data.get("dest") for f in errors} == {"converge_orphan"}
+    assert {f.data.get("surface")
+            for f in errors} == {"build_converge_parser"}

@@ -176,10 +176,11 @@ def test_batched_dispatch_bitwise_and_poison_isolation(stack):
         im1 = np.concatenate([np.asarray(p[0]) for p in ims])
         im2 = np.concatenate([np.asarray(p[1]) for p in ims])
         return server.cache(key, im1, im2, None)
-    _, up_bad_mate, finite_bad = (np.asarray(o) for o in
-                                  batch(bad_l, bad_r))
-    _, up_clean_mate, finite_clean = (np.asarray(o) for o in
-                                      batch(alt_l, alt_r))
+    # the converge-flavor program carries the curve as a 4th output
+    _, up_bad_mate, finite_bad, *_ = (np.asarray(o) for o in
+                                      batch(bad_l, bad_r))
+    _, up_clean_mate, finite_clean, *_ = (np.asarray(o) for o in
+                                          batch(alt_l, alt_r))
     assert list(finite_bad) == [True, False]
     assert list(finite_clean) == [True, True]
     np.testing.assert_array_equal(up_bad_mate[0], up_clean_mate[0])
@@ -215,15 +216,15 @@ def test_video_stream_warm_start_chains_flow_init(stack):
     zeros = np.zeros((1, bh // factor, bw // factor, 2), np.float32)
     p1 = [np.asarray(x) for x in padder.pad(l1[None], r1[None])]
     p2 = [np.asarray(x) for x in padder.pad(l2[None], r2[None])]
-    lr1, up1, _ = (np.asarray(o) for o in server.cache(key, *p1, zeros))
+    lr1, up1, *_ = (np.asarray(o) for o in server.cache(key, *p1, zeros))
     np.testing.assert_array_equal(res1.flow,
                                   np.asarray(padder.unpad(up1))[0])
-    _, up2_warm, _ = (np.asarray(o)
-                      for o in server.cache(key, *p2, lr1))
+    _, up2_warm, *_ = (np.asarray(o)
+                       for o in server.cache(key, *p2, lr1))
     np.testing.assert_array_equal(res2.flow,
                                   np.asarray(padder.unpad(up2_warm))[0])
-    _, up2_cold, _ = (np.asarray(o)
-                      for o in server.cache(key, *p2, zeros))
+    _, up2_cold, *_ = (np.asarray(o)
+                       for o in server.cache(key, *p2, zeros))
     assert not np.array_equal(up2_warm, up2_cold)
 
 
@@ -512,7 +513,7 @@ def test_cli_drift_v3_fires_on_seeded_serve_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 4
+    assert RULE_VERSIONS["cli-drift"] == 5
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "serve").mkdir(parents=True)
     (pkg / "cli.py").write_text(
