@@ -48,13 +48,16 @@ from raft_stereo_tpu.analysis.findings import Finding
 #: build_doctor_parser, consumed by obs/timeline.py and obs/doctor.py)
 #: plus the serve --no_metrics plumbing; v5 adds the convergence surface
 #: (build_converge_parser, consumed by obs/converge.py) plus the
-#: --no_converge/--iter_epe plumbing on the eval and serve surfaces — so
-#: earlier suppressions no longer mean what they said.
+#: --no_converge/--iter_epe plumbing on the eval and serve surfaces; v6
+#: adds the numerics surface (build_numerics_parser, consumed by
+#: obs/numerics.py) plus the --no_numerics/--numerics_every/--numerics
+#: plumbing on the train, eval and serve surfaces — so earlier
+#: suppressions no longer mean what they said.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 5,
+    "cli-drift": 6,
 }
 
 # Call names (last attribute segment) that trace their function arguments.
@@ -491,6 +494,10 @@ ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # early-exit simulator's main
     ("build_converge_parser", ("raft_stereo_tpu/cli.py",
                                "raft_stereo_tpu/obs/converge.py")),
+    # numerics surface (rule v6): declared in cli.py, consumed by the
+    # numerics-observatory replay's main
+    ("build_numerics_parser", ("raft_stereo_tpu/cli.py",
+                               "raft_stereo_tpu/obs/numerics.py")),
 )
 
 #: modules whose own argparse surface must be self-consumed, and whose

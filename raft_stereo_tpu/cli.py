@@ -172,6 +172,18 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
     f.add_argument("--anomaly_max_skips", type=int, default=10,
                    help="halt (for rollback to the last valid checkpoint) "
                         "after M consecutive skipped updates (0 = never)")
+    n = parser.add_argument_group(
+        "numerics observatory", "in-graph per-leaf gradient-norm "
+        "statistics and NaN provenance (obs/numerics.py; replay: "
+        "`cli numerics <run_dir>`; drill: scripts/numerics_drill.py)")
+    n.add_argument("--no_numerics", action="store_true",
+                   help="disable the per-leaf gradient-norm aux entirely; "
+                        "the train-step program and event stream are "
+                        "bitwise-identical to pre-v9 training")
+    n.add_argument("--numerics_every", type=int, default=50,
+                   help="emit one grad `numerics` event every N steps (a "
+                        "non-finite norm vector always emits regardless, "
+                        "so cadence never hides NaN provenance)")
 
 
 def train_config(args: argparse.Namespace) -> TrainConfig:
@@ -208,6 +220,8 @@ def train_config(args: argparse.Namespace) -> TrainConfig:
         ckpt_keep_every=args.ckpt_keep_every,
         anomaly_guard=not args.no_anomaly_guard,
         anomaly_max_skips=args.anomaly_max_skips,
+        numerics=not args.no_numerics,
+        numerics_every=args.numerics_every,
     )
 
 
@@ -288,6 +302,15 @@ def build_eval_parser() -> argparse.ArgumentParser:
                    help="additionally compute the in-graph per-iteration "
                         "EPE against GT (needs datasets with flow; implies "
                         "the convergence aux)")
+    n = parser.add_argument_group(
+        "numerics", "per-iteration activation-tap range statistics "
+        "(obs/numerics.py): min/max/absmean, bf16 saturation/underflow "
+        "counters and first-nonfinite NaN provenance as `numerics` "
+        "events, replayable by `cli numerics <run_dir>`")
+    n.add_argument("--no_numerics", action="store_true",
+                   help="disable the numerics aux entirely; the forward "
+                        "program and event stream are bitwise-identical "
+                        "to pre-v9 eval")
     add_model_args(parser)
     return parser
 
@@ -337,6 +360,12 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                    help="serve the 3-output program without the per-request "
                         "convergence aux: no converge events, no per-bucket "
                         "slo quality gauges (the schema-v7 pin)")
+    g.add_argument("--numerics", action="store_true",
+                   help="serve the numerics flavor (obs/numerics.py): "
+                        "per-dispatch activation-tap `numerics` events + "
+                        "per-bucket output-range drift gauges on the "
+                        "Prometheus /metrics endpoint; OFF by default — "
+                        "the served program stays byte-identical without it")
 
 
 def serve_config(args: argparse.Namespace):
@@ -345,7 +374,8 @@ def serve_config(args: argparse.Namespace):
         max_batch=args.max_batch, queue_depth=args.queue_depth,
         window=args.window, default_iters=args.iters, bucket=args.bucket,
         linger_s=args.linger_ms / 1e3, aot=not args.no_aot,
-        slo_every=args.slo_every, converge=not args.no_converge)
+        slo_every=args.slo_every, converge=not args.no_converge,
+        numerics=args.numerics)
 
 
 def _parse_shapes(specs) -> list:
@@ -433,10 +463,34 @@ def build_converge_parser() -> argparse.ArgumentParser:
                         default="both",
                         help="row granularity: per shape bucket, pooled "
                              "across buckets, or both")
-    parser.add_argument("--json", action="store_true",
-                        help="emit the table as JSON instead of text")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="write the decision-table JSON to this path; "
+                             "'-' prints the JSON to stdout INSTEAD of the "
+                             "text table (compare's convention — "
+                             "converge_drill's replay leg parses this)")
     parser.add_argument("--out", default=None,
                         help="also write the JSON table to this path")
+    return parser
+
+
+def build_numerics_parser() -> argparse.ArgumentParser:
+    """The ``cli numerics`` flag surface (consumed by obs/numerics.py)."""
+    parser = argparse.ArgumentParser(
+        prog="cli numerics",
+        description="Numerics-observatory replay: per-leaf gradient-norm "
+                    "trends, per-tap activation-range trends, the bf16 "
+                    "saturation leaderboard and the first-nonfinite (NaN "
+                    "provenance) report over a run's recorded `numerics` "
+                    "events — no model re-run")
+    parser.add_argument("run_dir",
+                        help="run directory (or events.jsonl path) holding "
+                             "numerics events")
+    parser.add_argument("--top", type=int, default=10,
+                        help="rows per table section (worst-first)")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="write the report JSON to this path; '-' "
+                             "prints the JSON to stdout INSTEAD of the "
+                             "text report (compare's convention)")
     return parser
 
 
@@ -666,7 +720,8 @@ def _eval_main():
     predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
                                 bucket=args.bucket,
                                 converge=not args.no_converge,
-                                iter_epe=args.iter_epe)
+                                iter_epe=args.iter_epe,
+                                numerics=not args.no_numerics)
     from raft_stereo_tpu.eval.stream import StreamConfig
     stream = StreamConfig(
         enabled={"auto": None, "on": True, "off": False}[args.stream],
@@ -682,7 +737,8 @@ def _eval_main():
                               "stream_window": args.stream_window,
                               "stream_microbatch": args.stream_microbatch,
                               "converge": not args.no_converge,
-                              "iter_epe": args.iter_epe})
+                              "iter_epe": args.iter_epe,
+                              "numerics": not args.no_numerics})
     try:
         if args.dataset.startswith("middlebury_"):
             results = validate_middlebury(predictor, args.data_root,
@@ -722,6 +778,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     * ``converge <run_dir>`` — the early-exit what-if simulator over a
       run's recorded convergence curves (obs/converge.py; the ROADMAP 1(b)
       decision table, computed offline),
+    * ``numerics <run_dir>`` — the numerics-observatory replay: per-leaf
+      gradient-norm trends, per-tap activation ranges, the bf16
+      saturation leaderboard and the first-nonfinite NaN-provenance
+      report (obs/numerics.py),
     * ``serve`` — continuous-batching HTTP serving with SLO telemetry,
       graceful drain and SIGHUP hot reload (raft_stereo_tpu/serve),
     * ``loadtest`` — the synthetic many-client serving drill vs a
@@ -733,7 +793,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     argv = list(sys.argv[1:] if argv is None else argv)
     commands = ("telemetry", "compare", "lint", "timeline", "doctor",
-                "converge", "train", "eval", "serve", "loadtest")
+                "converge", "numerics", "train", "eval", "serve", "loadtest")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -757,6 +817,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "converge":
         from raft_stereo_tpu.obs.converge import main as converge_main
         return converge_main(rest)
+    if cmd == "numerics":
+        from raft_stereo_tpu.obs.numerics import main as numerics_main
+        return numerics_main(rest)
     # the remaining mains parse sys.argv via argparse; present the
     # remainder as the whole command line
     sys.argv = [f"{sys.argv[0]} {cmd}"] + rest

@@ -298,6 +298,15 @@ class TrainConfig:
     # (0 = never halt; isolated skips only ever cost their own batch).
     anomaly_guard: bool = True
     anomaly_max_skips: int = 10
+    # Numerics observatory (obs/numerics.py): per-leaf gradient-norm
+    # vector in the train step's metrics, cadence-sampled into schema-v9
+    # `numerics` events every numerics_every steps (the vector itself is
+    # fetched with the lagged metrics either way; the cadence only gates
+    # event volume). Also arms top-k offending-leaf attribution on the
+    # anomaly event. numerics=False pins the step program byte-identical
+    # to the unobserved one (--no_numerics).
+    numerics: bool = True
+    numerics_every: int = 50
 
 
 # --- Named presets mirroring the reference's published training commands -------------

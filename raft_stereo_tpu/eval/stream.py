@@ -166,9 +166,25 @@ def _gt_kwargs(predictor, samples) -> Dict[str, np.ndarray]:
     return kw
 
 
+def _emit_numerics(telemetry, source, sample, aux, index) -> None:
+    """One dispatch's ``numerics`` record. The tap statistics are reduced
+    over the whole (micro-)batch in graph, so unlike the per-frame
+    converge curves there is exactly one record per dispatch — ``frame``
+    carries the group's first dataset index."""
+    if telemetry is None or aux is None:
+        return
+    taps = aux.get("numerics")
+    if not taps:
+        return
+    from raft_stereo_tpu.obs import numerics as numerics_obs
+    h, w = sample["image1"].shape[:2]
+    numerics_obs.emit(telemetry, numerics_obs.taps_payload(
+        source, taps, bucket=f"{h}x{w}", frame=index))
+
+
 def _emit_converge(telemetry, source, sample, aux, j, index) -> None:
     """One frame's ``converge`` record from a (possibly batched) aux."""
-    if telemetry is None or aux is None:
+    if telemetry is None or aux is None or "residual" not in aux:
         return
     from raft_stereo_tpu.obs import converge as converge_obs
     residual = np.asarray(aux["residual"])
@@ -212,8 +228,9 @@ def _run_sequential(predictor, dataset, consume, iters, telemetry, timed,
             fetch_s=max((t1 - t0) - dispatch_s, 0.0), device_s=dt_dev,
             e2e_s=t1 - t0, batch_size=1, in_flight=1)
         _emit_step(telemetry, i, timing)
-        _emit_converge(telemetry, source, sample,
-                       take_aux() if take_aux is not None else None, 0, i)
+        aux = take_aux() if take_aux is not None else None
+        _emit_converge(telemetry, source, sample, aux, 0, i)
+        _emit_numerics(telemetry, source, sample, aux, i)
         consume(i, sample, flow[0], timing)
 
 
@@ -270,6 +287,7 @@ def _run_streaming(predictor, dataset, consume, iters, cfg, telemetry,
                       parent=root)
         tracer.record("eval/dispatch", td0, td1, parent=root)
         tracer.record("eval/fetch", tr0, tr1, parent=root)
+        _emit_numerics(telemetry, source, group[0][1], aux, group[0][0])
         for j, (idx, sample) in enumerate(group):
             now = time.perf_counter()
             timing = FrameTiming(

@@ -101,12 +101,16 @@ class PendingPrediction:
             self._flow = None  # release the device buffer reference
         return self._result
 
-    def aux_result(self) -> Optional[Dict[str, np.ndarray]]:
-        """The convergence aux curves as numpy (``{"residual": (iters, B)``,
-        optionally ``"epe": (iters, B)}``), or None when the predictor ran
-        without them. Blocks like :meth:`result`; fetched once."""
+    def aux_result(self) -> Optional[Dict[str, Any]]:
+        """The aux outputs as numpy (``{"residual": (iters, B)``, optionally
+        ``"epe": (iters, B)``, optionally ``"numerics": {tap: (iters, 6)}}``),
+        or None when the predictor ran without them. Blocks like
+        :meth:`result`; fetched once."""
         if self._aux is not None and self._aux_np is None:
-            self._aux_np = {k: np.asarray(v) for k, v in self._aux.items()}
+            self._aux_np = {
+                k: ({kk: np.asarray(vv) for kk, vv in v.items()}
+                    if isinstance(v, dict) else np.asarray(v))
+                for k, v in self._aux.items()}
             self._aux = None
         return self._aux_np
 
@@ -121,7 +125,8 @@ class StereoPredictor:
 
     def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
                  valid_iters: int = 32, bucket: int = 0,
-                 converge: bool = False, iter_epe: bool = False):
+                 converge: bool = False, iter_epe: bool = False,
+                 numerics: bool = False):
         self.cfg = cfg
         self.model = create_model(cfg)
         self.variables = variables
@@ -134,6 +139,11 @@ class StereoPredictor:
         #: additionally compute the in-graph per-iteration low-res EPE
         #: proxy when the caller supplies ground truth (implies converge)
         self.iter_epe = iter_epe
+        #: record the per-iteration activation-tap range statistics
+        #: (obs/numerics.py; the model's ``numerics=True`` aux — a dict of
+        #: (iters, 6) stacks rides the aux LAST); False keeps the exact
+        #: prior program (the --no_numerics zero-overhead pin)
+        self.numerics = numerics
         if iter_epe:
             self.converge = True
         self._last_aux: Optional[Dict[str, np.ndarray]] = None
@@ -156,25 +166,34 @@ class StereoPredictor:
 
     def _forward(self, shape: Tuple[int, int, int], iters: int,
                  with_gt: bool = False):
-        key = shape + (iters, self.converge, with_gt)
+        key = shape + (iters, self.converge, with_gt, self.numerics)
         fn = self._compiled.get(key)
         if fn is None:
             model = self.model
+            numerics = self.numerics
 
             if self.converge and with_gt:
                 def run(variables, image1, image2, flow_gt, valid):
                     return model.apply(variables, image1, image2,
                                        iters=iters, test_mode=True,
                                        iter_metrics="per_sample",
-                                       flow_gt=flow_gt, loss_mask=valid)
+                                       flow_gt=flow_gt, loss_mask=valid,
+                                       numerics=numerics)
             elif self.converge:
                 def run(variables, image1, image2):
                     return model.apply(variables, image1, image2,
                                        iters=iters, test_mode=True,
-                                       iter_metrics="per_sample")
+                                       iter_metrics="per_sample",
+                                       numerics=numerics)
+            elif numerics:
+                def run(variables, image1, image2):
+                    return model.apply(variables, image1, image2,
+                                       iters=iters, test_mode=True,
+                                       numerics=True)
             else:
-                # converge off: the exact prior program (the --no_converge
-                # zero-overhead pin, tests/test_converge.py)
+                # converge+numerics off: the exact prior program (the
+                # --no_converge/--no_numerics zero-overhead pins,
+                # tests/test_converge.py and tests/test_numerics.py)
                 def run(variables, image1, image2):
                     return model.apply(variables, image1, image2,
                                        iters=iters, test_mode=True)
@@ -215,14 +234,40 @@ class StereoPredictor:
                            with_gt=bool(gt_args))
         return padder, fn, im1, im2, gt_args, ctx
 
+    def _aux_of(self, outs) -> Optional[Dict[str, Any]]:
+        """Slot the aux outputs after (flow_lr, flow_up) into a dict.
+
+        Layout (models/raft_stereo.py): residual, then epe when GT was
+        supplied, then the numerics tap dict LAST. Values stay whatever
+        they are (device arrays here; the fetch points convert)."""
+        if not (self.converge or self.numerics):
+            return None
+        rest = list(outs[2:])
+        aux: Dict[str, Any] = {}
+        if self.numerics:
+            aux["numerics"] = rest.pop()
+        if self.converge:
+            aux["residual"] = rest[0]
+            if len(rest) > 1:
+                aux["epe"] = rest[1]
+        return aux
+
+    @staticmethod
+    def _aux_np(aux: Optional[Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+        """D2H-fetch an aux dict (the numerics entry is a nested dict of
+        per-tap stacks)."""
+        if aux is None:
+            return None
+        return {k: ({kk: np.asarray(vv) for kk, vv in v.items()}
+                    if isinstance(v, dict) else np.asarray(v))
+                for k, v in aux.items()}
+
     def _stash_aux(self, outs) -> None:
-        """Fetch + stash the converge aux of a sync call for take_aux()."""
-        if not self.converge:
-            return
-        aux = {"residual": np.asarray(outs[2])}
-        if len(outs) > 3:
-            aux["epe"] = np.asarray(outs[3])
-        self._last_aux = aux
+        """Fetch + stash the aux of a sync call for take_aux()."""
+        aux = self._aux_np(self._aux_of(outs))
+        if aux is not None:
+            self._last_aux = aux
 
     def take_aux(self) -> Optional[Dict[str, np.ndarray]]:
         """Pop the convergence aux curves of the LAST synchronous call
@@ -297,13 +342,9 @@ class StereoPredictor:
             image1, image2, iters, flow_gt, valid)
         with ctx:
             outs = fn(self.variables, im1, im2, *gt_args)
-        aux = None
-        if self.converge:
-            aux = {"residual": outs[2]}
-            if len(outs) > 3:
-                aux["epe"] = outs[3]
         return PendingPrediction(outs[1], padder.unpad,
-                                 time.perf_counter() - t0, aux=aux)
+                                 time.perf_counter() - t0,
+                                 aux=self._aux_of(outs))
 
     def compute_disparity(self, left: np.ndarray, right: np.ndarray,
                           iters: Optional[int] = None) -> np.ndarray:

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.nn.encoder import BasicEncoder, MultiBasicEncoder
-from raft_stereo_tpu.nn.gru import BasicMultiUpdateBlock, tag_residual
+from raft_stereo_tpu.nn.gru import (BasicMultiUpdateBlock, numerics_taps,
+                                    record_numerics_tap, tag_residual)
 from raft_stereo_tpu.nn.layers import Conv, ResidualBlock
 from raft_stereo_tpu.ops.corr import CorrState, corr_lookup, init_corr
 from raft_stereo_tpu.ops.geometry import (
@@ -179,7 +180,8 @@ class RefinementStep(nn.Module):
         else:
             corr = corr_lookup(corr_state, coords1)
             corr = tag_residual(corr.astype(dt0) if dt0 else corr,
-                                "corr_feats", self.save_dtype)
+                                "corr_feats", self.save_dtype,
+                                tap="corr_feats")
 
         cfg = self.cfg
         dt = self.dtype
@@ -207,6 +209,9 @@ class RefinementStep(nn.Module):
 
         # stereo: project the update onto the epipolar line
         delta_flow = delta_flow.astype(jnp.float32)
+        # numerics tap (inert without an armed sink): the raw flow-head
+        # output is the first place an exploding refinement shows
+        record_numerics_tap(delta_flow, "delta_flow")
         delta_flow = delta_flow.at[..., 1].set(0.0)
         coords1 = coords1 + delta_flow
 
@@ -259,7 +264,7 @@ class RAFTStereo(nn.Module):
     def __call__(self, image1, image2, iters: int = 12, flow_init=None,
                  test_mode: bool = False, flow_gt=None, loss_mask=None,
                  stage: str = "full", enc_outs=None,
-                 iter_metrics: bool = False):
+                 iter_metrics: bool = False, numerics: bool = False):
         """``flow_gt``/``loss_mask`` (both ``(B, H, W, 1)``) switch on the
         fused-loss training path: returns ``(per_iter_err_sums (iters,),
         final flow_up (B, H, W, 1))`` instead of the stacked predictions —
@@ -301,6 +306,15 @@ class RAFTStereo(nn.Module):
         shaped like ``delta_norms``; the return becomes ``(flow_lowres,
         flow_up, delta_norms, epes)``. With ``flow_gt=None`` the graph is
         byte-identical to the plain ``iter_metrics`` one.
+
+        ``numerics`` (test mode only; the numerics observatory,
+        obs/numerics.py): additionally return a dict of per-iteration
+        ``(iters, 6)`` range-statistics stacks — one per activation tap
+        (corr_feats, each GRU's zr/q gates, delta_flow), keys carrying a
+        trace-order prefix — appended as the LAST element of the return
+        tuple. ``False`` (the default) arms no tap sink, so the traced
+        program is byte-identical to the numerics-free one (the
+        ``--no_numerics`` pin).
         """
         cfg = self.cfg
         dt = self.compute_dtype
@@ -308,7 +322,8 @@ class RAFTStereo(nn.Module):
         if stage == "refine":
             cnet_list, fmap1, fmap2 = enc_outs
             return self._refine(cnet_list, fmap1, fmap2, iters, flow_init,
-                                test_mode, flow_gt, loss_mask, iter_metrics)
+                                test_mode, flow_gt, loss_mask, iter_metrics,
+                                numerics)
 
         image1 = (2.0 * (image1 / 255.0) - 1.0).astype(jnp.float32)
         image2 = (2.0 * (image2 / 255.0) - 1.0).astype(jnp.float32)
@@ -399,10 +414,11 @@ class RAFTStereo(nn.Module):
         if stage == "encode":
             return tuple(cnet_list), fmap1, fmap2
         return self._refine(tuple(cnet_list), fmap1, fmap2, iters, flow_init,
-                            test_mode, flow_gt, loss_mask, iter_metrics)
+                            test_mode, flow_gt, loss_mask, iter_metrics,
+                            numerics)
 
     def _refine(self, cnet_list, fmap1, fmap2, iters, flow_init, test_mode,
-                flow_gt, loss_mask, iter_metrics=False):
+                flow_gt, loss_mask, iter_metrics=False, numerics=False):
         """Post-encoder forward: context processing, correlation pyramid, the
         refinement scan, and the upsample/loss tail. Called from the compact
         ``__call__`` (both the monolithic and staged paths)."""
@@ -418,6 +434,11 @@ class RAFTStereo(nn.Module):
             raise ValueError("the test_mode iter-EPE aux rides the "
                              "iter_metrics scan outputs; pass "
                              "iter_metrics=True or 'per_sample'")
+        if numerics and not test_mode:
+            raise ValueError("the numerics tap aux exists on the test_mode "
+                             "(inference) scan only; the training side is "
+                             "the per-leaf gradient-norm vector "
+                             "(training/state.py numerics=True)")
         cfg = self.cfg
         dt = self.compute_dtype
 
@@ -531,16 +552,30 @@ class RAFTStereo(nn.Module):
                 iter_epe = _epe_of
 
             def scan_iter(mdl, c, _):
-                c2, _unused = mdl(c, corr_state, tuple(inp_list), coords0,
-                                  None, compute_mask=False)
+                # the numerics_taps sink is armed around the body trace
+                # only: tag_residual/record_numerics_tap sites deposit one
+                # fused stats vector each, collected into the scan's
+                # stacked ys (numerics=False arms nothing and the body is
+                # byte-identical to the numerics-free trace)
+                if numerics:
+                    with numerics_taps() as sink:
+                        c2, _unused = mdl(c, corr_state, tuple(inp_list),
+                                          coords0, None, compute_mask=False)
+                    taps = dict(sink)
+                else:
+                    c2, _unused = mdl(c, corr_state, tuple(inp_list),
+                                      coords0, None, compute_mask=False)
                 # aux ys; None keeps the default graph byte-identical
                 y = _residual(c2, c) if iter_metrics else None
                 if iter_epe is not None:
                     y = (y, iter_epe(c2))
+                if numerics:
+                    y = (y, taps)
                 return c2, y
 
             delta_norms = None
             scanned_epes = None
+            scanned_taps = None
             if iters > 1:
                 carry, scanned = nn.scan(
                     scan_iter,
@@ -549,16 +584,32 @@ class RAFTStereo(nn.Module):
                     length=iters - 1,
                     unroll=cfg.scan_unroll,
                 )(refine, carry, None)
+                if numerics:
+                    scanned, scanned_taps = scanned
                 if iter_epe is not None:
                     scanned, scanned_epes = scanned
                 if iter_metrics:
                     delta_norms = scanned
             pre_final = carry
-            carry, mask = refine(carry, corr_state, tuple(inp_list), coords0,
-                                 None)
+            if numerics:
+                with numerics_taps() as final_sink:
+                    carry, mask = refine(carry, corr_state, tuple(inp_list),
+                                         coords0, None)
+            else:
+                carry, mask = refine(carry, corr_state, tuple(inp_list),
+                                     coords0, None)
             coords1 = carry[1]
             flow_up = upsample_disparity_convex(coords1 - coords0, mask,
                                                 cfg.factor)
+            tap_stats = None
+            if numerics:
+                # per-key (iters, 6) stacks: scanned iterations + the
+                # final unscanned one (same body, same tap sites — the
+                # mask head it adds carries no tap)
+                tap_stats = {
+                    k: (v[None] if scanned_taps is None
+                        else jnp.concatenate([scanned_taps[k], v[None]]))
+                    for k, v in final_sink.items()}
             if iter_metrics:
                 final_norm = _residual(carry, pre_final)[None]
                 delta_norms = (final_norm if delta_norms is None else
@@ -567,9 +618,13 @@ class RAFTStereo(nn.Module):
                     final_epe = iter_epe(carry)[None]
                     epes = (final_epe if scanned_epes is None else
                             jnp.concatenate([scanned_epes, final_epe]))
-                    return coords1 - coords0, flow_up, delta_norms, epes
-                return coords1 - coords0, flow_up, delta_norms
-            return coords1 - coords0, flow_up
+                    ret = (coords1 - coords0, flow_up, delta_norms, epes)
+                else:
+                    ret = (coords1 - coords0, flow_up, delta_norms)
+            else:
+                ret = (coords1 - coords0, flow_up)
+            # the numerics tap dict is always the LAST element
+            return ret if tap_stats is None else ret + (tap_stats,)
         if fused and not deferred:
             carry = (tuple(net_list), coords1,
                      jnp.zeros((b, h * cfg.factor, w * cfg.factor, 1),

@@ -9,18 +9,105 @@ biases ``cz, cr, cq`` are precomputed once outside the refinement loop and
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 from jax.ad_checkpoint import checkpoint_name
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.nn.layers import Conv
+from raft_stereo_tpu.obs.numerics import BF16_MAX_FINITE, BF16_MIN_NORMAL
 from raft_stereo_tpu.ops.geometry import pool2x, resize_bilinear_align_corners
 
 Dtype = Any
+
+
+# --- numerics tap sink (obs/numerics.py's in-graph half) ---------------------
+#
+# The numerics observatory needs per-iteration range statistics at the
+# residual tag sites — the exact tensors the bf16 save policy narrows —
+# without changing the traced program when it is off. The sink is a
+# module-level collection point: :func:`numerics_taps` arms it around a
+# model apply (models/raft_stereo.py's scan body trace), every
+# ``tag_residual``/``record_numerics_tap`` call that executes while it is
+# armed deposits one fused (len(STAT_FIELDS),) stats vector, and the model
+# threads the collected dict through the scan's stacked outputs. Sink
+# ``None`` (the default, and always the case under training/jit without
+# the context) makes every recording call a no-op that returns its input
+# untouched — the byte-identical ``--no_numerics`` pin rests on this.
+
+_tap_sink = None
+
+#: bf16 saturation rail — see obs/numerics.py: finite fp32 never rounds to
+#: bf16 inf, so "|x| at/above the bf16 max finite" IS the overflow signal
+_BF16_MAX = BF16_MAX_FINITE
+
+#: fp32 bit pattern of the smallest normal bf16 — the underflow rail
+_BF16_MIN_BITS = np.float32(BF16_MIN_NORMAL).view(np.uint32)
+
+
+def _tap_stats(x):
+    """Fused range/health statistics for one tap: a stacked
+    ``[min, max, absmean, nonfinite, sat, underflow]`` vector (fp32; the
+    order is obs/numerics.py's STAT_FIELDS). min/max/absmean are over the
+    finite values (an all-NaN tensor yields +/-inf sentinels the host
+    cleans to null); the bf16 counters are computed against bfloat16
+    regardless of the tap's own dtype, because these are the tensors the
+    ``residual_dtype="bfloat16"`` save policy and the corr bf16 storage
+    policy narrow."""
+    x32 = x.astype(jnp.float32)
+    finite = jnp.isfinite(x32)
+    f32 = jnp.float32
+    minv = jnp.min(jnp.where(finite, x32, jnp.inf))
+    maxv = jnp.max(jnp.where(finite, x32, -jnp.inf))
+    absmean = jnp.mean(jnp.where(finite, jnp.abs(x32), 0.0))
+    nonfinite = jnp.sum((~finite).astype(f32))
+    sat = jnp.sum((jnp.abs(x32) >= _BF16_MAX).astype(f32))
+    # underflow: nonzero magnitudes in bf16's flush-to-zero regime. Tested
+    # on the raw bit pattern — XLA's float compares run denormals-as-zero
+    # on CPU, so `x != 0` is False for exactly the values this counts
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    mag = bits & jnp.uint32(0x7FFFFFFF)
+    underflow = jnp.sum(
+        ((mag != 0) & (mag < jnp.uint32(_BF16_MIN_BITS))).astype(f32))
+    return jnp.stack([minv, maxv, absmean, nonfinite, sat, underflow])
+
+
+@contextlib.contextmanager
+def numerics_taps():
+    """Arm the tap sink for the duration of one model apply; yields the
+    dict the recording calls fill. Keys are ``"<order>:<label>"`` — the
+    2-digit trace-order prefix survives the sorted-key flattening jit
+    applies to dict outputs, so consumers (obs/numerics.py
+    ``split_label``) recover dataflow order for first-nonfinite
+    tie-breaking. Re-entrant: the previous sink is restored on exit."""
+    global _tap_sink
+    prev = _tap_sink
+    _tap_sink = {}
+    try:
+        yield _tap_sink
+    finally:
+        _tap_sink = prev
+
+
+def record_numerics_tap(x, label):
+    """Deposit ``x``'s stats in the armed sink (no-op, returning ``x``
+    unchanged, when no sink is armed). A label recorded twice in one trace
+    (e.g. the slow_fast pre-iterations re-running a GRU) gets ``#2``,
+    ``#3``... suffixes — every call site stays distinguishable."""
+    if _tap_sink is None:
+        return x
+    base = label
+    n = 2
+    while any(k.partition(":")[2] == label for k in _tap_sink):
+        label = f"{base}#{n}"
+        n += 1
+    _tap_sink[f"{len(_tap_sink):02d}:{label}"] = _tap_stats(x)
+    return x
 
 
 class _ConvParams(nn.Module):
@@ -88,7 +175,7 @@ def _split_input_conv(parts, kernel, bias, pad, dt, tap=None, path=None,
     return out + bias
 
 
-def tag_residual(x, name, save_dtype=None):
+def tag_residual(x, name, save_dtype=None, tap=None):
     """``checkpoint_name`` with an optional lean storage dtype.
 
     With ``save_dtype`` set (``config.residual_dtype`` while a selective
@@ -97,7 +184,15 @@ def tag_residual(x, name, save_dtype=None):
     narrowed copy, and downstream compute continues from its upcast. This
     halves the named residual stacks at the cost of one rounding on the
     saved value (the documented-tolerance regime; the custom-VJP scan
-    instead narrows only its saved copies and leaves the forward exact)."""
+    instead narrows only its saved copies and leaves the forward exact).
+
+    ``tap`` names the site for the numerics observatory: when a
+    :func:`numerics_taps` sink is armed, the PRE-cast value's range stats
+    are recorded under that label (the pre-cast value is the one whose
+    bf16 saturation/underflow the counters measure). Without an armed
+    sink the tap is inert — the traced program is unchanged."""
+    if tap is not None:
+        record_numerics_tap(x, tap)
     if save_dtype is None or x.dtype == jnp.dtype(save_dtype):
         return checkpoint_name(x, name)
     return checkpoint_name(x.astype(save_dtype), name).astype(x.dtype)
@@ -156,6 +251,9 @@ class ConvGRU(nn.Module):
         parts = [h, *x_list]
         in_ch = sum(v.shape[-1] for v in parts)
         path = tuple(self.scope.path)
+        # numerics tap labels lead with the GRU level ("gru32.zr"); a
+        # top-level application (unit tests) has an empty scope path
+        site = path[-1] if path else "gru"
 
         kz, bz = _ConvParams((k, k), in_ch, self.hidden_dim, name="convz")()
         kr, br = _ConvParams((k, k), in_ch, self.hidden_dim, name="convr")()
@@ -172,14 +270,16 @@ class ConvGRU(nn.Module):
         # models/raft_stereo.py (save_only_these_names when the estimated
         # residuals fit; full remat otherwise — PERF.md r2 inversion).
         # Inert under the custom-VJP scan, which stacks these sites itself.
-        zr = tag_residual(zr, "gru_zr", self.save_dtype)
+        zr = tag_residual(zr, "gru_zr", self.save_dtype,
+                          tap=f"{site}.zr")
         z, r = jnp.split(zr, 2, axis=-1)
         z = nn.sigmoid(z + cz)
         r = nn.sigmoid(r + cr)
         kq, bq = _ConvParams((k, k), in_ch, self.hidden_dim, name="convq")()
         q = _split_input_conv([r * h, *x_list], kq.astype(dt),
                               bq.astype(dt), p, dt, tap, path, "q")
-        q = tag_residual(q, "gru_q", self.save_dtype)
+        q = tag_residual(q, "gru_q", self.save_dtype,
+                         tap=f"{site}.q")
         q = nn.tanh(q + cq)
         return (1 - z) * h + z * q
 

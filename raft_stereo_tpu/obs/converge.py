@@ -262,9 +262,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)
-    if args.json:
-        print(json.dumps(doc, indent=2))
+    if args.json == "-":
+        # the cli compare convention: '-' streams the JSON to stdout
+        # INSTEAD of the text table (converge_drill's replay leg and
+        # other machine consumers parse this)
+        json.dump(doc, sys.stdout, indent=1)
+        sys.stdout.write("\n")
     else:
+        if args.json:
+            os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+            with open(args.json, "w") as f:
+                json.dump(doc, f, indent=2)
         budget = max(int(r["iters"]) for r in records)
         print(f"{len(records)} curves, iteration budget {budget} "
               f"({args.run_dir})")

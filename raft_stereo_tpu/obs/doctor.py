@@ -28,11 +28,19 @@ that earned it:
   device time refining disparities that had stopped moving. Evidence
   quotes "p95 converged by iter k of N" and points at ``cli converge``
   for the full threshold sweep.
+* **NONFINITE_ORIGIN / BF16_SATURATION / GRAD_EXPLOSION /
+  NUMERICS_CLEAN** (own ``numerics`` phase, additive) — the schema-v9
+  numerics observatory's verdicts, in that priority order: the recorded
+  tap statistics name the first tap+iteration that went non-finite (NaN
+  provenance), the bf16 stacks that clipped at the format rail, or the
+  parameter leaf whose gradient norm exploded. Evidence points at
+  ``cli numerics`` for the full per-leaf/per-tap replay.
 
 Rules read the ``step``/``request``/``slo``/``loader``/``stall``/
 ``compile`` records (all pre-v7), so doctor works on old artifacts too;
 v7 spans sharpen the serve phase split, v8 converge curves add the
-over-iteration rule, when present.
+over-iteration rule, v9 numerics records add the numerics phase, when
+present.
 """
 
 from __future__ import annotations
@@ -230,6 +238,81 @@ def _diagnose_converge(records) -> Optional[Dict[str, Any]]:
     ])
 
 
+def _diagnose_numerics(records) -> Optional[Dict[str, Any]]:
+    """The numerics observatory's verdict, in severity order:
+    NONFINITE_ORIGIN > BF16_SATURATION > GRAD_EXPLOSION > NUMERICS_CLEAN.
+    None when the run recorded no numerics events (pre-v9 artifacts)."""
+    from raft_stereo_tpu.obs.numerics import GRAD_ALARM_NORM, split_label
+    numerics = [r for r in records if r.get("event") == "numerics"]
+    if not numerics:
+        return None
+    phase = "numerics"
+    grads = [r for r in numerics if r.get("kind") == "grad"]
+    taps = [r for r in numerics if r.get("kind") == "taps"]
+    # 1) non-finite provenance — a NaN origin trumps everything else
+    for r in taps:
+        fnf = r.get("first_nonfinite")
+        if fnf:
+            return _verdict(phase, "NONFINITE_ORIGIN", [
+                f"first non-finite values at tap '{fnf.get('tap')}' "
+                f"iteration {fnf.get('iter')} ({fnf.get('count')} "
+                f"elements; source {r.get('source')})",
+                "every later NaN is downstream of this site — fix the "
+                "producer, not the symptoms",
+                "full per-tap series: `cli numerics <run_dir>`",
+            ])
+    for r in grads:
+        bad = [n for n, v in zip(r.get("leaves", []),
+                                 r.get("grad_norm", [])) if v is None]
+        if bad:
+            return _verdict(phase, "NONFINITE_ORIGIN", [
+                f"non-finite gradient norm at step {r.get('step')} in "
+                f"{len(bad)} leaf/leaves; first: {bad[0]}",
+                "the anomaly guard skips these updates; the named leaf "
+                "is where the backward first blew up",
+                "full per-leaf trend: `cli numerics <run_dir>`",
+            ])
+    # 2) bf16 rail hits — silent clipping that precedes overflow-to-inf
+    sat_by_tap: Dict[str, int] = {}
+    for r in taps:
+        for key, series in (r.get("taps") or {}).items():
+            s = sum(int(v) for v in series.get("sat", []) if v)
+            if s:
+                label = split_label(key)[1] if ":" in key else key
+                sat_by_tap[label] = sat_by_tap.get(label, 0) + s
+    if sat_by_tap:
+        worst = max(sat_by_tap, key=lambda k: sat_by_tap[k])
+        return _verdict(phase, "BF16_SATURATION", [
+            f"{sum(sat_by_tap.values())} values at the bf16 finite rail "
+            f"across {len(sat_by_tap)} tap(s); worst: '{worst}' "
+            f"({sat_by_tap[worst]} hits)",
+            "values at the rail clip silently and overflow to inf one "
+            "scale later — rescale or lift this stack to fp32",
+            "saturation leaderboard: `cli numerics <run_dir>`",
+        ])
+    # 3) finite but exploding gradients
+    worst_leaf, worst_norm, worst_step = None, 0.0, None
+    for r in grads:
+        for name, v in zip(r.get("leaves", []), r.get("grad_norm", [])):
+            if v is not None and float(v) > worst_norm:
+                worst_leaf, worst_norm = name, float(v)
+                worst_step = r.get("step")
+    if worst_leaf is not None and worst_norm > GRAD_ALARM_NORM:
+        return _verdict(phase, "GRAD_EXPLOSION", [
+            f"leaf '{worst_leaf}' gradient norm {worst_norm:.3g} at step "
+            f"{worst_step} (alarm threshold {GRAD_ALARM_NORM:g})",
+            "clip harder, lower the LR, or check this leaf's input "
+            "statistics before it goes non-finite",
+            "per-leaf trend: `cli numerics <run_dir>`",
+        ])
+    n_taps = sum(len(r.get("taps") or {}) for r in taps)
+    return _verdict(phase, "NUMERICS_CLEAN", [
+        f"{len(grads)} grad record(s) and {len(taps)} tap record(s) "
+        f"({n_taps} tap series): all finite, no bf16 rail hits, no "
+        f"gradient norm above {GRAD_ALARM_NORM:g}",
+    ])
+
+
 def diagnose(run_dir: str) -> Dict[str, Any]:
     """Diagnose one run dir; returns ``{"run_dir", "verdicts": [...]}``.
 
@@ -241,7 +324,8 @@ def diagnose(run_dir: str) -> Dict[str, Any]:
     records = read_events(events_path)
     verdicts = [v for v in (_diagnose_train(records),
                             _diagnose_serve(records),
-                            _diagnose_converge(records)) if v is not None]
+                            _diagnose_converge(records),
+                            _diagnose_numerics(records)) if v is not None]
     if not verdicts:
         verdicts = [_verdict("run", "UNKNOWN", [
             "no step or request records — nothing to diagnose"])]

@@ -27,20 +27,22 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # Back-compat: every schema version whose artifacts are still readable.
 # v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
 # op_counts jaxpr profile event), v3 -> v4 (the graftlint `lint` report
 # event), v4 -> v5 (the fault-tolerance events: preempt/resume/
 # ckpt_integrity/anomaly), v5 -> v6 (the serving events: request/queue/
-# slo), v6 -> v7 (the tracing events: span/flightrec) and v7 -> v8 (the
+# slo), v6 -> v7 (the tracing events: span/flightrec), v7 -> v8 (the
 # convergence-observatory `converge` event; the `slo` quality fields ride
-# as optional extras) were purely ADDITIVE — no earlier event changed its
-# required fields — so pre-existing runs/*/events.jsonl lint clean: an
-# older record is validated against its own surface (it just may not use
-# events introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+# as optional extras) and v8 -> v9 (the numerics-observatory `numerics`
+# event; the `anomaly` top-leaf attribution and the `slo` output-range
+# gauges ride as optional extras) were purely ADDITIVE — no earlier event
+# changed its required fields — so pre-existing runs/*/events.jsonl lint
+# clean: an older record is validated against its own surface (it just
+# may not use events introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -59,6 +61,7 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "span": 7,
     "flightrec": 7,
     "converge": 8,
+    "numerics": 9,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -159,6 +162,25 @@ EVENT_TYPES: Dict[str, tuple] = {
     # additionally carry an optional `quality` extra: rolling per-bucket
     # final-residual percentiles (serve quality-drift monitoring).
     "converge": ("source", "iters", "idx", "residual"),
+    # Numerics observatory (obs/numerics.py, schema v9). `numerics`: one
+    # record per train cadence window / eval frame dispatch / served batch
+    # carrying in-graph numeric health statistics. `source` names the
+    # producer ("train", "eval:<validator>", "serve:<bucket>"), `kind`
+    # selects the payload shape: "grad" records carry `step`, `leaves`
+    # (flattened param-leaf names) and `grad_norm` (per-leaf L2 norms,
+    # null where non-finite — the NaN marker JSON can carry) from the
+    # train step's fused per-leaf reduction; "taps" records carry `iters`
+    # and `taps` — per activation-tap {min,max,absmean,nonfinite,sat,
+    # underflow} series over the refinement iterations (bf16 saturation =
+    # |x| at/above the bf16 max finite, underflow = nonzero fp32 flushed
+    # to bf16 zero), plus `first_nonfinite` {tap, iter} NaN provenance,
+    # `sat_total`/`underflow_total` rollups and `bucket`/`frame`/`id`
+    # extras. Consistency is linted by obs/validate.py
+    # check_numerics_integrity. The v9 `anomaly` records additionally
+    # carry an optional `top_leaves` extra (top-k offending-leaf
+    # attribution) and the v9 `slo` quality gauges optional per-bucket
+    # output-range percentiles (serve output drift).
+    "numerics": ("source", "kind"),
     "run_end": ("steps",),
 }
 

@@ -95,6 +95,83 @@ def check_converge_integrity(records: Iterable[dict]) -> List[str]:
     return errors
 
 
+def check_numerics_integrity(records: Iterable[dict]) -> List[str]:
+    """Consistency of schema-v9 ``numerics`` records (obs/numerics.py).
+
+    grad records must keep ``leaves`` and ``grad_norm`` parallel (the
+    attribution hinges on index alignment), with every norm a number or
+    null (null IS the NaN marker — a NaN literal would not round-trip
+    strict JSON). taps records must keep every stat series the same
+    length as the advertised iteration count, counters non-negative, and
+    the ``first_nonfinite`` pointer referentially valid: it must name a
+    recorded tap and an in-range iteration whose nonfinite counter is
+    actually positive.
+    """
+    from raft_stereo_tpu.obs.numerics import STAT_FIELDS
+    recs = [r for r in records
+            if isinstance(r, dict) and r.get("event") == "numerics"]
+    errors: List[str] = []
+    for n, r in enumerate(recs):
+        kind = r.get("kind")
+        tag = f"numerics #{n} ({r.get('source')!r}, kind={kind!r})"
+        if kind == "grad":
+            leaves, norms = r.get("leaves"), r.get("grad_norm")
+            if not isinstance(leaves, list) or not isinstance(norms, list):
+                errors.append(f"{tag}: leaves/grad_norm malformed")
+                continue
+            if len(leaves) != len(norms):
+                errors.append(f"{tag}: {len(leaves)} leaves vs "
+                              f"{len(norms)} grad_norm values")
+            if not all(v is None or isinstance(v, (int, float))
+                       for v in norms):
+                errors.append(f"{tag}: grad_norm values must be numbers "
+                              "or null")
+        elif kind == "taps":
+            taps, iters = r.get("taps"), r.get("iters")
+            if not isinstance(taps, dict) or not isinstance(iters, int):
+                errors.append(f"{tag}: taps/iters malformed")
+                continue
+            for label, series in taps.items():
+                if not isinstance(series, dict):
+                    errors.append(f"{tag}: tap {label!r} series malformed")
+                    continue
+                for field in STAT_FIELDS:
+                    vals = series.get(field)
+                    if not isinstance(vals, list) or len(vals) != iters:
+                        errors.append(f"{tag}: tap {label!r} {field} "
+                                      f"series is not length iters={iters}")
+                    elif field in ("nonfinite", "sat", "underflow") \
+                            and any(isinstance(v, (int, float)) and v < 0
+                                    for v in vals):
+                        errors.append(f"{tag}: tap {label!r} negative "
+                                      f"{field} counter")
+            fn = r.get("first_nonfinite")
+            if fn is not None:
+                if not isinstance(fn, dict):
+                    errors.append(f"{tag}: first_nonfinite malformed")
+                elif fn.get("tap") not in taps:
+                    errors.append(f"{tag}: first_nonfinite names unknown "
+                                  f"tap {fn.get('tap')!r}")
+                elif not isinstance(fn.get("iter"), int) \
+                        or not 0 <= fn["iter"] < iters:
+                    errors.append(f"{tag}: first_nonfinite iter "
+                                  f"{fn.get('iter')!r} outside "
+                                  f"[0, {iters})")
+                else:
+                    series = taps[fn["tap"]].get("nonfinite")
+                    if isinstance(series, list) and len(series) > fn["iter"] \
+                            and not (isinstance(series[fn["iter"]],
+                                                (int, float))
+                                     and series[fn["iter"]] > 0):
+                        errors.append(
+                            f"{tag}: first_nonfinite points at tap "
+                            f"{fn['tap']!r} iter {fn['iter']} but its "
+                            "nonfinite counter is not positive there")
+        else:
+            errors.append(f"{tag}: unknown kind (expected grad|taps)")
+    return errors
+
+
 def check_path(path: str) -> List[str]:
     """Validate one ``events.jsonl`` (or a run directory containing one).
 
@@ -115,6 +192,7 @@ def check_path(path: str) -> List[str]:
     errors = validate_events(records)
     errors.extend(check_span_integrity(records))
     errors.extend(check_converge_integrity(records))
+    errors.extend(check_numerics_integrity(records))
     return [f"{path}: {e}" for e in errors]
 
 

@@ -52,7 +52,8 @@ from raft_stereo_tpu.training.state import TrainState, make_train_step
 
 def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh,
                              fused_loss: bool = False,
-                             anomaly_guard: bool = True):
+                             anomaly_guard: bool = True,
+                             numerics: bool = False):
     """Explicit-collective DP train step (state replicated, batch sharded on B).
 
     ``fused_loss`` selects the in-scan/tile-layout loss (the fastest measured
@@ -64,11 +65,16 @@ def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh,
     skip in :func:`make_train_step`. Its predicate reads the psum'd
     gradients/loss, so every shard takes the same branch — no divergence,
     no extra collective.
+
+    ``numerics`` (obs/numerics.py): the per-leaf gradient-norm vector
+    rides the metrics dict. It is computed from the psum'd gradients, so
+    it is replicated across shards and the ``P()`` out_spec holds.
     """
     per_shard_step = make_train_step(model, tx, train_iters,
                                      axis_name=DATA_AXIS,
                                      fused_loss=fused_loss,
-                                     anomaly_guard=anomaly_guard)
+                                     anomaly_guard=anomaly_guard,
+                                     numerics=numerics)
 
     batch_spec = {"image1": P(DATA_AXIS), "image2": P(DATA_AXIS),
                   "flow": P(DATA_AXIS), "valid": P(DATA_AXIS)}
@@ -84,7 +90,8 @@ def make_shardmap_train_step(model, tx, train_iters: int, mesh: Mesh,
 
 def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
                          fused_loss: bool = False,
-                         anomaly_guard: bool = True):
+                         anomaly_guard: bool = True,
+                         numerics: bool = False):
     """Auto-SPMD dp+sp train step: jit with sharding-annotated inputs.
 
     ``fused_loss`` is written globally (no explicit collectives): the SPMD
@@ -107,7 +114,8 @@ def make_pjit_train_step(model, tx, train_iters: int, mesh: Mesh,
             cfg=dataclasses.replace(model.cfg, fused_lookup=False))
     step = make_train_step(model, tx, train_iters, axis_name=None,
                            fused_loss=fused_loss,
-                           anomaly_guard=anomaly_guard)
+                           anomaly_guard=anomaly_guard,
+                           numerics=numerics)
     state_sharding = replicated(mesh)
     return jax.jit(
         step,

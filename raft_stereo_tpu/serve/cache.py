@@ -64,7 +64,8 @@ class ExecutableCache:
     """
 
     def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
-                 telemetry=None, aot: bool = True, converge: bool = False):
+                 telemetry=None, aot: bool = True, converge: bool = False,
+                 numerics: bool = False):
         self.cfg = cfg
         self.model = create_model(cfg)
         self.telemetry = telemetry
@@ -75,6 +76,12 @@ class ExecutableCache:
         #: and the SLO quality gauges. False keeps the exact 3-output
         #: program of schema v7 (the --no_converge pin).
         self.converge = converge
+        #: serve the numerics flavor (obs/numerics.py): the program
+        #: additionally returns the per-iteration activation-tap range
+        #: statistics ({tap: (iters, 6)}) as the LAST output, feeding the
+        #: per-dispatch ``numerics`` events. False keeps the exact prior
+        #: program (the --no_numerics pin; serve's default).
+        self.numerics = numerics
         self._lock = threading.Lock()
         self._entries: Dict[BucketKey, Any] = {}
         self._variables = variables
@@ -110,19 +117,24 @@ class ExecutableCache:
     def _build(self, key: BucketKey):
         model, iters = self.model, key.iters
         converge = self.converge
+        numerics = self.numerics
 
         def forward(variables, im1, im2, flow_init=None):
-            """(flow_lr, flow_up, finite[, deltas]) — the converge flavor
-            appends the per-sample convergence curves as a 4th output."""
+            """(flow_lr, flow_up, finite[, deltas][, taps]) — the converge
+            flavor appends the per-sample convergence curves, the numerics
+            flavor the per-iteration tap-statistics dict (always LAST)."""
             metrics = "per_sample" if converge else False
             out = model.apply(variables, im1, im2, iters=iters,
                               flow_init=flow_init, test_mode=True,
-                              iter_metrics=metrics)
+                              iter_metrics=metrics, numerics=numerics)
             flow_lr, flow_up = out[0], out[1]
             finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
+            ret = (flow_lr, flow_up, finite)
             if converge:
-                return flow_lr, flow_up, finite, out[2]
-            return flow_lr, flow_up, finite
+                ret = ret + (out[2],)
+            if numerics:
+                ret = ret + (out[-1],)
+            return ret
 
         if key.warm:
             def run(variables, im1, im2, flow_init):
@@ -203,8 +215,9 @@ class ExecutableCache:
                  flow_init: Optional[np.ndarray] = None):
         """Run the key's program with the CURRENT variables; returns
         ``(flow_lowres, flow_up, finite_flags)`` device arrays — plus a
-        4th ``(iters, B)`` convergence-curve array when the cache was
-        built with ``converge=True``."""
+        ``(iters, B)`` convergence-curve array when the cache was built
+        with ``converge=True``, plus (always last) the numerics
+        tap-statistics dict when built with ``numerics=True``."""
         fn = self.get(key)
         variables = self.variables
         if key.warm:

@@ -94,6 +94,20 @@ _PROM_QUALITY = (
 )
 
 
+# per-bucket output-range drift gauges (stats()["output_range"], present
+# when the server runs the numerics flavor, ``cli serve --numerics``):
+# rolling extremes of the served flow per shape bucket — the scrapeable
+# signal for a model starting to rail or collapse its outputs.
+_PROM_OUTPUT_RANGE = (
+    ("output_min_p05", "raft_serve_output_min_p05",
+     "Rolling p05 of per-request output flow minimum (px)"),
+    ("output_max_p95", "raft_serve_output_max_p95",
+     "Rolling p95 of per-request output flow maximum (px)"),
+    ("n", "raft_serve_output_range_window_requests",
+     "Requests inside the rolling output-range window"),
+)
+
+
 def prometheus_metrics(stats: dict) -> str:
     """Render a ``stats()`` dict as Prometheus text exposition format."""
     lines = []
@@ -113,6 +127,17 @@ def prometheus_metrics(stats: dict) -> str:
             lines.append(f"# TYPE {name} gauge")
             for bucket in sorted(quality):
                 value = quality[bucket].get(key)
+                if value is None:
+                    continue
+                lines.append(f'{name}{{bucket="{bucket}"}} '
+                             f"{float(value):g}")
+    ranges = stats.get("output_range") or {}
+    if ranges:
+        for key, name, help_text in _PROM_OUTPUT_RANGE:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            for bucket in sorted(ranges):
+                value = ranges[bucket].get(key)
                 if value is None:
                     continue
                 lines.append(f'{name}{{bucket="{bucket}"}} '

@@ -57,6 +57,7 @@ import numpy as np
 
 from raft_stereo_tpu.config import RAFTStereoConfig
 from raft_stereo_tpu.inference import PAD_DIVIS, bucket_size
+from raft_stereo_tpu.obs import numerics as numerics_obs
 from raft_stereo_tpu.obs.converge import emit as converge_emit
 from raft_stereo_tpu.obs.trace import NULL_TRACER
 from raft_stereo_tpu.ops.geometry import InputPadder
@@ -105,6 +106,13 @@ class ServeConfig:
     #: gauges in the slo rollups / Prometheus metrics. False
     #: (--no_converge) keeps the exact schema-v7 program and event stream.
     converge: bool = True
+    #: serve the numerics program flavor (obs/numerics.py): per-dispatch
+    #: activation-tap range statistics (`numerics` events) + per-bucket
+    #: output-range drift gauges in the slo rollups / Prometheus metrics.
+    #: OFF by default (opt in with --numerics): serving pays for
+    #: observability only when asked, and the default program stays
+    #: byte-identical to the numerics-free one.
+    numerics: bool = False
 
 
 @dataclasses.dataclass
@@ -126,6 +134,10 @@ class ServeResult:
     bucket: str = ""
     #: last-iteration mean |Δdisparity| (converge aux; None when off)
     final_residual: Optional[float] = None
+    #: host-side min/max of the unpadded output flow (numerics flavor's
+    #: output-range drift gauges; None on errors or with numerics off)
+    output_min: Optional[float] = None
+    output_max: Optional[float] = None
 
     @property
     def disparity(self) -> Optional[np.ndarray]:
@@ -186,7 +198,8 @@ class StereoServer:
         self.telemetry = telemetry
         self.cache = ExecutableCache(cfg, variables, telemetry=telemetry,
                                      aot=self.serve.aot,
-                                     converge=self.serve.converge)
+                                     converge=self.serve.converge,
+                                     numerics=self.serve.numerics)
         self.slo = SLOTracker(telemetry, window=self.serve.slo_window,
                               emit_every=self.serve.slo_every)
         self._queue: BoundedQueue = BoundedQueue(self.serve.queue_depth)
@@ -421,12 +434,23 @@ class StereoServer:
             flow_lr = np.asarray(flow_lr)
             flow_up = np.asarray(flow_up)
             finite = np.asarray(finite)
-            # (iters, B) per-sample convergence curves (converge flavor)
-            deltas = np.asarray(aux[0]) if aux else None
+            # aux slots, in program-output order: converge's (iters, B)
+            # per-sample curves first, the numerics tap-stats dict LAST
+            deltas = None
+            taps = None
+            if aux and self.serve.numerics:
+                taps = {k: np.asarray(v) for k, v in aux.pop().items()}
+            if aux and self.serve.converge:
+                deltas = np.asarray(aux[0])
         except Exception as exc:  # device-side execution error
             self._fail_group(group, key, exc, kind="dispatch")
             return
         now = time.perf_counter()
+        if taps is not None:
+            # one numerics record per DISPATCH (the stats are batch-wide)
+            numerics_obs.emit(self.telemetry, numerics_obs.taps_payload(
+                f"serve:{key.label()}", taps,
+                bucket=f"{key.height}x{key.width}", id=group[0].id))
         for j, req in enumerate(group):
             if not bool(finite[j]):
                 # per-request isolation: THIS request failed; batchmates
@@ -443,6 +467,12 @@ class StereoServer:
                     batch_size=len(group), bucket=key.label()))
                 continue
             flow = np.asarray(padders[j].unpad(flow_up[j:j + 1]))[0]
+            output_min = output_max = None
+            if taps is not None:
+                # per-request output range feeding the drift gauges —
+                # only paid for when the numerics flavor is on
+                output_min = float(np.min(flow))
+                output_max = float(np.max(flow))
             if req.warm and req.stream is not None:
                 self._sessions[req.stream] = (flow_lr[j].shape,
                                               flow_lr[j])
@@ -458,7 +488,8 @@ class StereoServer:
                 latency_s=now - req.t_submit,
                 queue_wait_s=req.t_dispatch - req.t_submit,
                 batch_size=len(group), bucket=key.label(),
-                final_residual=final_residual))
+                final_residual=final_residual,
+                output_min=output_min, output_max=output_max))
 
     def _fail_group(self, group: List[_Request], key: BucketKey,
                     exc: BaseException, kind: str) -> None:
@@ -485,7 +516,8 @@ class StereoServer:
             bucket=result.bucket, batch_size=result.batch_size,
             in_flight=len(self._in_flight), stream=req.stream,
             error=result.error, traceback_tail=result.traceback,
-            final_residual=result.final_residual)
+            final_residual=result.final_residual,
+            output_min=result.output_min, output_max=result.output_max)
         # the request's span tree, from the lifecycle stamps already taken:
         # queue_wait / collect_group / dispatch / retire tile the root
         # exactly (end = submit + the latency the client was told)

@@ -16,7 +16,11 @@ Three records ride the existing event bus (obs/telemetry.py):
   carries a ``quality`` extra when the server runs with the convergence
   aux: rolling per-bucket final-residual percentiles (how settled the
   iteration actually is at retirement) — the gauge that makes quality
-  drift after a hot reload visible instead of silent.
+  drift after a hot reload visible instead of silent. Since schema v9 an
+  ``output_range`` extra rides the same rollup when the numerics flavor
+  is on: per-bucket rolling output-min p05 / output-max p95 of the served
+  flow — the drift gauge that catches a model starting to rail its
+  outputs before clients do.
 
 The tracker is lock-guarded (scheduler thread retires, client threads
 admit) and, like every telemetry path in this repo, fail-open: with
@@ -54,6 +58,9 @@ class SLOTracker:
         # rolling final-residual window per bucket label (the serve
         # quality gauges; fed only when the converge aux is on)
         self._quality: Dict[str, "deque"] = {}
+        # rolling (output_min, output_max) window per bucket label — the
+        # output-range drift gauges; fed only when the numerics aux is on
+        self._ranges: Dict[str, "deque"] = {}
         self.admitted = 0
         self.completed = 0
         self.failed = 0
@@ -83,11 +90,16 @@ class SLOTracker:
                in_flight: int, stream: Optional[str] = None,
                error: Optional[str] = None,
                traceback_tail: Optional[str] = None,
-               final_residual: Optional[float] = None) -> None:
+               final_residual: Optional[float] = None,
+               output_min: Optional[float] = None,
+               output_max: Optional[float] = None) -> None:
         """Record one terminal request outcome; emits the ``request`` event
         and, on cadence, the ``slo`` rollup. ``final_residual`` (mean
         |Δdisparity| of the last refinement iteration, from the converge
-        aux) feeds the per-bucket rolling quality gauges."""
+        aux) feeds the per-bucket rolling quality gauges; ``output_min``/
+        ``output_max`` (host range of the request's unpadded flow, from
+        the numerics flavor) feed the per-bucket output-range drift
+        gauges."""
         now = time.monotonic()
         with self._lock:
             if status == "ok":
@@ -100,6 +112,12 @@ class SLOTracker:
                 if dq is None:
                     dq = self._quality[bucket] = deque(maxlen=self.window)
                 dq.append(float(final_residual))
+            if (output_min is not None and output_max is not None
+                    and status == "ok"):
+                rq = self._ranges.get(bucket)
+                if rq is None:
+                    rq = self._ranges[bucket] = deque(maxlen=self.window)
+                rq.append((float(output_min), float(output_max)))
             self._retired_since_emit += 1
             do_slo = self._retired_since_emit >= self.emit_every
             if do_slo:
@@ -119,6 +137,10 @@ class SLOTracker:
                 payload["traceback"] = traceback_tail[-2000:]
             if final_residual is not None:
                 payload["final_residual"] = round(float(final_residual), 6)
+            if output_min is not None:
+                payload["output_min"] = round(float(output_min), 4)
+            if output_max is not None:
+                payload["output_max"] = round(float(output_max), 4)
             self.telemetry.emit("request", **payload)
             if do_slo:
                 self.telemetry.emit("slo", **slo)
@@ -153,6 +175,17 @@ class SLOTracker:
                     "n": len(dq),
                 }
                 for bucket, dq in sorted(self._quality.items()) if dq
+            }
+        if self._ranges:
+            snap["output_range"] = {
+                bucket: {
+                    "output_min_p05": round(percentile(
+                        sorted(lo for lo, _ in rq), 5), 4),
+                    "output_max_p95": round(percentile(
+                        sorted(hi for _, hi in rq), 95), 4),
+                    "n": len(rq),
+                }
+                for bucket, rq in sorted(self._ranges.items()) if rq
             }
         return snap
 

@@ -505,21 +505,31 @@ class AnomalyPolicy:
         self.total = 0
 
     def observe(self, skipped: bool, step: int,
-                grad_norm: Optional[float] = None) -> None:
+                grad_norm: Optional[float] = None,
+                top_leaves=None) -> None:
+        """``top_leaves`` (optional, v9 numerics observatory): the
+        ``[[leaf_name, norm-or-None], ...]`` offender ranking from the
+        per-leaf norm vector — rides the ``anomaly`` event so a skipped
+        update names WHICH leaves went non-finite, not just that one did."""
         if not skipped:
             self.consecutive = 0
             return
         self.consecutive += 1
         self.total += 1
         logger.warning(
-            "step %d: non-finite gradients (grad_norm=%s) — optimizer "
+            "step %d: non-finite gradients (grad_norm=%s%s) — optimizer "
             "update skipped on device (%d consecutive, %d total)",
-            step, grad_norm, self.consecutive, self.total)
+            step, grad_norm,
+            "" if not top_leaves else f", worst leaves {top_leaves[:3]}",
+            self.consecutive, self.total)
         if self.telemetry is not None:
+            extra = {} if top_leaves is None else {
+                "top_leaves": [[str(n), v] for n, v in top_leaves]}
             self.telemetry.emit(
                 "anomaly", kind="nonfinite_grad", step=int(step),
                 grad_norm=None if grad_norm is None else float(grad_norm),
-                consecutive=self.consecutive, skipped_total=self.total)
+                consecutive=self.consecutive, skipped_total=self.total,
+                **extra)
         if 0 < self.max_consecutive <= self.consecutive:
             if self.telemetry is not None:
                 self.telemetry.emit(

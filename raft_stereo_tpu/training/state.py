@@ -38,7 +38,7 @@ class TrainState(struct.PyTreeNode):
 
 def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
                     axis_name=None, fused_loss: bool = False,
-                    anomaly_guard: bool = True):
+                    anomaly_guard: bool = True, numerics: bool = False):
     """Build the jittable training step.
 
     ``batch``: dict with ``image1``/``image2`` ``(B,H,W,3)`` float images,
@@ -67,6 +67,17 @@ def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
     off the lagged metrics fetch and halts after M consecutive skips.
     Under ``shard_map`` the predicate is computed from the psum'd gradients
     and loss, so every device takes the same branch.
+
+    ``numerics`` (the numerics observatory, obs/numerics.py): metrics gain
+    ``leaf_grad_norms`` — one L2 norm per parameter leaf, in
+    ``jax.tree.leaves`` order (``grad_leaf_names`` recovers the labels),
+    computed as one fused square-sum reduction per leaf with a single
+    vectorized sqrt at the end. Same no-host-sync, ``lax.cond``-free
+    discipline as the guard: the vector stays on device until the lagged
+    metrics fetch, where the trainer cadence-samples it into ``numerics``
+    events and hands the top offenders to the ``anomaly`` attribution.
+    Off (the default) adds zero operations — the program is byte-identical
+    to the unobserved step.
     """
     import jax.numpy as jnp
 
@@ -92,6 +103,13 @@ def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
             loss_fn, has_aux=True)(state.params)
         if axis_name is not None:
             grads = jax.lax.psum(grads, axis_name)
+        if numerics:
+            # per-leaf L2 norms: one fused sum-of-squares per leaf, one
+            # vectorized sqrt over the stacked vector — NaN/Inf propagate
+            # into the affected slot (that IS the provenance signal)
+            leaf_sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in jax.tree_util.tree_leaves(grads)]
+            leaf_grad_norms = jnp.sqrt(jnp.stack(leaf_sq))
         if anomaly_guard:
             grad_norm = optax.global_norm(grads)
             finite = jnp.isfinite(grad_norm) & jnp.isfinite(loss)
@@ -116,6 +134,8 @@ def make_train_step(model, tx: optax.GradientTransformation, train_iters: int,
                                            state.params)
             params = optax.apply_updates(state.params, updates)
             metrics = dict(metrics, loss=loss)
+        if numerics:
+            metrics = dict(metrics, leaf_grad_norms=leaf_grad_norms)
         new_state = state.replace(params=params, opt_state=opt_state,
                                   step=state.step + 1)
         return new_state, metrics

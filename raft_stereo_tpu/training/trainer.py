@@ -220,11 +220,19 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
     policy = resilience.AnomalyPolicy(
         cfg.anomaly_max_skips if cfg.anomaly_guard else 0, telemetry=tel)
     nan_step = resilience.injected_nan_step()
+    # numerics observatory (obs/numerics.py): leaf names are recovered
+    # once — same flatten order as the in-step per-leaf norm vector
+    if cfg.numerics:
+        from raft_stereo_tpu.obs import numerics as obs_numerics
+        leaf_names = obs_numerics.grad_leaf_names(variables["params"])
+    else:
+        obs_numerics, leaf_names = None, None
 
     with mesh:
         state = jax.device_put(state, replicated(mesh))
         step_fn = make_pjit_train_step(model, tx, cfg.train_iters, mesh,
-                                       anomaly_guard=cfg.anomaly_guard)
+                                       anomaly_guard=cfg.anomaly_guard,
+                                       numerics=cfg.numerics)
 
         # console/TB logging rides the run dir telemetry owns; write_dict
         # mirrors validation results onto the event bus
@@ -245,14 +253,28 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                 return
             step_i, metrics, timing = pending
             pending = None
+            metrics = dict(metrics)
+            # the per-leaf norm vector is NOT a logging scalar: pop it
+            # before the float() sweep, cadence-sample it onto the bus
+            leaf_norms = metrics.pop("leaf_grad_norms", None)
             vals = {k: float(v) for k, v in metrics.items()}
+            top = None
+            if leaf_norms is not None:
+                norms = np.asarray(leaf_norms)
+                top = obs_numerics.top_leaves(leaf_names, norms)
+                # a poisoned vector always emits — cadence must never
+                # hide the step that carries the provenance
+                if (step_i % max(cfg.numerics_every, 1) == 0
+                        or not np.all(np.isfinite(norms))):
+                    obs_numerics.emit(tel, obs_numerics.grad_payload(
+                        step_i, leaf_names, norms))
             log.push(vals, lr=float(schedule((step_i - 1) // accum_k)))
             extras = {k: vals[k]
                       for k in ("loss", "grad_norm", "skipped_updates")
                       if k in vals}
             tel.step(step_i, batch_size=cfg.batch_size, **timing, **extras)
             policy.observe(bool(vals.get("skipped_updates", 0.0)), step_i,
-                           grad_norm=vals.get("grad_norm"))
+                           grad_norm=vals.get("grad_norm"), top_leaves=top)
 
         with resilience.SignalGuard() as guard:
             try:

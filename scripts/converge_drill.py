@@ -97,7 +97,7 @@ def _replay(run_dir, expect_epe):
     """`cli converge` over a recorded run; returns (errors, summary)."""
     errors = []
     rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
-                    "converge", run_dir, "--json"])
+                    "converge", run_dir, "--json", "-"])
     if rc != 0:
         return [f"cli converge rc={rc}: {out.splitlines()[-1:]}"], None
     try:

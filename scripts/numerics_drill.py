@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Numerics-observatory rehearsal: prove attribution on seeded faults.
+
+The observatory's acceptance bar (r15) is not "the unit tests pass" — it
+is that seeded numeric faults come back with the CORRECT attribution
+through the real recording + replay pipeline:
+
+1. **train leg** — a tiny ``cli train`` with an injected all-NaN batch
+   (``RAFT_FAULT_NAN_STEP``, the fault_drill fixture): the run must
+   survive (anomaly guard), the grad ``numerics`` record at the injected
+   step must carry null per-leaf norms, the ``anomaly`` event must name
+   the offending leaves (``top_leaves``), and ``cli doctor --json`` must
+   return the NONFINITE_ORIGIN verdict.
+2. **fixture leg** — in-process seeded tensors: (a) a NaN-poisoned input
+   through the real tiny model must attribute ``first_nonfinite`` to the
+   dataflow-earliest tap (``corr_feats``) at iteration 0, and doctor must
+   echo it; (b) a seeded bf16-overflow/underflow stack (3.4e38 / 1e-41)
+   must fire the saturation + underflow counters, put the tap on
+   ``cli numerics``'s leaderboard, and earn the BF16_SATURATION verdict.
+3. **eval leg** — a tiny ``cli eval --stream on`` over a synthetic
+   FlyingThings TEST tree with numerics ON (the default): every dispatch
+   must leave a ``taps`` record that lints clean under schema v9, and
+   ``cli numerics <run_dir> --json -`` must replay them.
+4. **serve leg** — a tiny ``cli loadtest --numerics``: per-dispatch
+   ``numerics`` events, per-request ``output_min``/``output_max``, and
+   the per-bucket ``output_range`` gauges on the slo rollup.
+
+Each leg appends a dated JSON record to
+``runs/numerics_drill/drills.jsonl``; exit non-zero if any check failed.
+Driven by scripts/rehearse_round.py's ``numerics`` leg.
+
+Run: JAX_PLATFORMS=cpu python scripts/numerics_drill.py [--keep-work]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+OUT = os.path.join(REPO, "runs", "numerics_drill")
+LOG = os.path.join(OUT, "drills.jsonl")
+
+CHILD_TIMEOUT_S = 900.0
+ITERS = 4
+NAN_STEP = 2
+
+
+def _run(cmd, env_extra=None, timeout=CHILD_TIMEOUT_S):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=timeout, env=env)
+    return proc.returncode, proc.stdout or ""
+
+
+def _records(run_dir):
+    from raft_stereo_tpu.obs.events import read_events
+    return read_events(os.path.join(run_dir, "events.jsonl"))
+
+
+def _numerics(records, kind=None):
+    out = [r for r in records if r.get("event") == "numerics"]
+    if kind is not None:
+        out = [r for r in out if r.get("kind") == kind]
+    return out
+
+
+def _lint(run_dir):
+    from raft_stereo_tpu.obs.validate import check_path
+    return check_path(run_dir)
+
+
+def _doctor_verdict(run_dir, phase="numerics"):
+    """(verdict, errors) of `cli doctor --json` for one phase."""
+    rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
+                    "doctor", run_dir, "--json"])
+    if rc != 0:
+        return None, [f"cli doctor rc={rc}: {out.splitlines()[-1:]}"]
+    try:
+        doc = json.loads(out[out.index("{"):])
+    except ValueError as e:
+        return None, [f"unparseable doctor report: {e}"]
+    for v in doc.get("verdicts", []):
+        if v.get("phase") == phase:
+            return v.get("verdict"), []
+    return None, [f"doctor report carries no {phase} phase verdict"]
+
+
+def _replay(run_dir):
+    """`cli numerics --json -` over a recorded run; (errors, report)."""
+    rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
+                    "numerics", run_dir, "--json", "-"])
+    if rc != 0:
+        return [f"cli numerics rc={rc}: {out.splitlines()[-1:]}"], None
+    try:
+        doc = json.loads(out[out.index("{"):])
+    except ValueError as e:
+        return [f"unparseable numerics report: {e}"], None
+    return [], doc
+
+
+def drill_train(work):
+    """Seeded NaN batch: grad record must carry the null-leaf provenance
+    and the anomaly event the top-leaves attribution."""
+    from fault_drill import make_sceneflow_tree, run_child
+    make_sceneflow_tree(os.path.join(work, "data"))
+    rc, run_dir, log = run_child(
+        "numerics@nan-train", work, steps=4, ckpt_every=100,
+        env_extra={"RAFT_FAULT_NAN_STEP": str(NAN_STEP)})
+    if rc != 0:
+        return {"drill": "train", "ok": False,
+                "error": f"train rc={rc}; see {log}"}
+    errors = []
+    records = _records(run_dir)
+    grads = _numerics(records, kind="grad")
+    if not grads:
+        errors.append("train run emitted no grad numerics events")
+    poisoned = [r for r in grads if r.get("step") == NAN_STEP
+                and any(v is None for v in r.get("grad_norm", []))]
+    if grads and not poisoned:
+        errors.append(f"no null-norm grad record at the injected step "
+                      f"{NAN_STEP} (cadence must not hide provenance)")
+    anomalies = [r for r in records if r.get("event") == "anomaly"
+                 and r.get("kind") == "nonfinite_grad"]
+    if not any(a.get("top_leaves") for a in anomalies):
+        errors.append("anomaly event carries no top_leaves attribution")
+    lint = _lint(run_dir)
+    if lint:
+        errors.append(f"v9 lint: {lint[:3]}")
+    verdict, verr = _doctor_verdict(run_dir)
+    errors.extend(verr)
+    if verdict is not None and verdict != "NONFINITE_ORIGIN":
+        errors.append(f"doctor verdict {verdict} != NONFINITE_ORIGIN")
+    replay_errors, report = _replay(run_dir)
+    errors.extend(replay_errors)
+    if report is not None and not any(
+            e.get("kind") == "grad" and e.get("step") == NAN_STEP
+            for e in report.get("first_nonfinite", [])):
+        errors.append("replay report misses the grad NaN origin")
+    return {"drill": "train", "ok": not errors, "run_dir": run_dir,
+            "grad_events": len(grads), "verdict": verdict,
+            "error": "; ".join(errors) or None}
+
+
+def drill_fixture(work):
+    """In-process attribution checks: NaN-poisoned input through the real
+    model, and a seeded bf16 overflow/underflow stack."""
+    import numpy as np
+
+    from raft_stereo_tpu.config import RAFTStereoConfig
+    from raft_stereo_tpu.inference import StereoPredictor
+    from raft_stereo_tpu.models import init_model
+    from raft_stereo_tpu.obs import Telemetry
+    from raft_stereo_tpu.obs import numerics as obs_numerics
+    import jax
+
+    errors = []
+    # (a) NaN provenance through the real forward: the poisoned input
+    # must surface at the dataflow-earliest tap, iteration 0
+    cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, 48, 64, 3))
+    predictor = StereoPredictor(cfg, variables, valid_iters=ITERS,
+                                numerics=True)
+    img = np.random.default_rng(0).uniform(
+        0, 255, (1, 48, 64, 3)).astype(np.float32)
+    poisoned = img.copy()
+    poisoned[0, 10:14, 10:14, :] = np.nan
+    predictor(poisoned, img)
+    aux = predictor.take_aux()
+    taps = aux.get("numerics") if aux else None
+    nan_dir = os.path.join(work, "runs", "fixture_nan")
+    payload = obs_numerics.taps_payload("fixture:nan", taps or {},
+                                        bucket="48x64", frame=0)
+    fnf = (payload or {}).get("first_nonfinite")
+    if not fnf:
+        errors.append("NaN input left no first_nonfinite")
+    elif fnf.get("tap") != "corr_feats" or fnf.get("iter") != 0:
+        errors.append(f"NaN origin misattributed: {fnf} != "
+                      f"{{'tap': 'corr_feats', 'iter': 0}}")
+    with Telemetry(nan_dir, stall_deadline_s=None) as tel:
+        tel.run_start(config={"mode": "numerics-fixture-nan"})
+        obs_numerics.emit(tel, payload)
+        tel.emit("run_end", steps=1, ok=True)
+    verdict, verr = _doctor_verdict(nan_dir)
+    errors.extend(verr)
+    if verdict is not None and verdict != "NONFINITE_ORIGIN":
+        errors.append(f"NaN fixture verdict {verdict} != NONFINITE_ORIGIN")
+
+    # (b) bf16 counters on seeded values: at-the-rail magnitudes count as
+    # saturation, subnormal-below-bf16 values as underflow-to-zero
+    from raft_stereo_tpu.nn.gru import numerics_taps, record_numerics_tap
+
+    def fixture(x, y):
+        # the sink is armed inside the trace (the model-apply pattern):
+        # the recorded stat vectors are this call's outputs
+        with numerics_taps() as sink:
+            record_numerics_tap(x, "overflow_stack")
+            record_numerics_tap(y, "underflow_stack")
+            return dict(sink)
+
+    stacks = {}
+    for _ in range(2):  # two "iterations" of the same taps
+        out = jax.jit(fixture)(np.full((8, 8), 3.4e38, np.float32),
+                               np.full((8, 8), 1e-41, np.float32))
+        for k, v in out.items():
+            stacks.setdefault(k, []).append(np.asarray(v))
+    taps2 = {k: np.stack(v) for k, v in stacks.items()}
+    payload2 = obs_numerics.taps_payload("fixture:bf16", taps2,
+                                         bucket="8x8", frame=0)
+    if not payload2 or payload2.get("sat_total", 0) <= 0:
+        errors.append("seeded 3.4e38 stack fired no saturation counter")
+    if not payload2 or payload2.get("underflow_total", 0) <= 0:
+        errors.append("seeded 1e-41 stack fired no underflow counter")
+    bf16_dir = os.path.join(work, "runs", "fixture_bf16")
+    with Telemetry(bf16_dir, stall_deadline_s=None) as tel:
+        tel.run_start(config={"mode": "numerics-fixture-bf16"})
+        obs_numerics.emit(tel, payload2)
+        tel.emit("run_end", steps=1, ok=True)
+    verdict2, verr = _doctor_verdict(bf16_dir)
+    errors.extend(verr)
+    if verdict2 is not None and verdict2 != "BF16_SATURATION":
+        errors.append(f"bf16 fixture verdict {verdict2} != BF16_SATURATION")
+    replay_errors, report = _replay(bf16_dir)
+    errors.extend(replay_errors)
+    if report is not None and not any(
+            r.get("tap") == "overflow_stack"
+            for r in report.get("saturation", [])):
+        errors.append("leaderboard misses the seeded overflow stack")
+    for d in (nan_dir, bf16_dir):
+        lint = _lint(d)
+        if lint:
+            errors.append(f"v9 lint ({os.path.basename(d)}): {lint[:3]}")
+    return {"drill": "fixture", "ok": not errors,
+            "nan_origin": fnf, "sat": (payload2 or {}).get("sat_total"),
+            "underflow": (payload2 or {}).get("underflow_total"),
+            "verdicts": [verdict, verdict2],
+            "error": "; ".join(errors) or None}
+
+
+def drill_eval(work):
+    from converge_drill import make_things_test_tree
+    data = os.path.join(work, "data_eval")
+    make_things_test_tree(data)
+    run_dir = os.path.join(work, "runs", "eval")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "eval",
+        "--dataset", "things", "--data_root", data,
+        "--run_dir", run_dir, "--stream", "on",
+        "--valid_iters", str(ITERS),
+        "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "eval", "ok": False, "error": f"eval rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    errors = []
+    taps = _numerics(_records(run_dir), kind="taps")
+    if not taps:
+        errors.append("eval run emitted no taps numerics events")
+    if taps and not all("corr_feats" in (r.get("taps") or {})
+                        and "delta_flow" in (r.get("taps") or {})
+                        for r in taps):
+        errors.append("tap records miss the corr/delta taps")
+    lint = _lint(run_dir)
+    if lint:
+        errors.append(f"v9 lint: {lint[:3]}")
+    replay_errors, report = _replay(run_dir)
+    errors.extend(replay_errors)
+    if report is not None and not report.get("taps"):
+        errors.append("replay report has no tap trend rows")
+    return {"drill": "eval", "ok": not errors, "run_dir": run_dir,
+            "dispatches": len(taps), "error": "; ".join(errors) or None}
+
+
+def drill_serve(work):
+    run_dir = os.path.join(work, "loadtest")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "loadtest",
+        "--run_dir", run_dir, "--no_baseline", "--no_progress",
+        "--numerics", "--shapes", "48x96", "64x128",
+        "--clients", "3", "--requests_per_client", "2",
+        "--video_streams", "0", "--max_batch", "2", "--window", "2",
+        "--iters", str(ITERS), "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "serve", "ok": False, "error": f"loadtest rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    serve_dir = os.path.join(run_dir, "serve")
+    errors = []
+    records = _records(serve_dir)
+    taps = _numerics(records, kind="taps")
+    if not taps:
+        errors.append("serve run emitted no numerics events")
+    oks = [r for r in records if r.get("event") == "request"
+           and r.get("status") == "ok"]
+    if not any("output_min" in r and "output_max" in r for r in oks):
+        errors.append("no request record carries the output range")
+    if not any(e.get("event") == "slo" and "output_range" in e
+               for e in records):
+        errors.append("no slo rollup carries the output_range gauges")
+    lint = _lint(serve_dir)
+    if lint:
+        errors.append(f"v9 lint: {lint[:3]}")
+    replay_errors, report = _replay(serve_dir)
+    errors.extend(replay_errors)
+    return {"drill": "serve", "ok": not errors, "run_dir": serve_dir,
+            "dispatches": len(taps), "requests": len(oks),
+            "error": "; ".join(errors) or None}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="numerics-observatory rehearsal over seeded faults "
+                    "(see module doc)")
+    p.add_argument("--keep-work", action="store_true",
+                   help="keep the scratch tree (default: delete on exit)")
+    args = p.parse_args(argv)
+
+    from raft_stereo_tpu.obs.events import append_json_log
+
+    os.makedirs(OUT, exist_ok=True)
+    work = tempfile.mkdtemp(prefix="numerics_drill_")
+    t0 = time.monotonic()
+    try:
+        records = [drill_train(work), drill_fixture(work),
+                   drill_eval(work), drill_serve(work)]
+    finally:
+        if args.keep_work:
+            print(f"work tree kept: {work}")
+        else:
+            shutil.rmtree(work, ignore_errors=True)
+    ok = True
+    for rec in records:
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        append_json_log(LOG, rec, stream=sys.stderr)
+        ok = ok and rec["ok"]
+    print(("NUMERICS DRILL ok: " if ok else "NUMERICS DRILL FAILED: ")
+          + ", ".join(f"{r['drill']}={'ok' if r['ok'] else 'FAIL'}"
+                      for r in records))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,14 @@ rehearsal:
   must replay them into a non-empty early-exit decision table
   (EPE-delta columns on the GT-backed eval leg) without re-running the
   model.
+* **numerics** — the numerics-observatory rehearsal (r15): ``python
+  scripts/numerics_drill.py`` — seeded faults must come back with the
+  CORRECT attribution: an injected all-NaN train batch names its step
+  and leaves (NONFINITE_ORIGIN), a NaN-poisoned eval input names the
+  dataflow-earliest tap at iteration 0, a seeded bf16-overflow stack
+  fires the saturation counters (BF16_SATURATION), and a
+  ``cli loadtest --numerics`` leaves per-dispatch ``numerics`` events
+  plus the per-bucket output-range gauges.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
@@ -222,10 +230,10 @@ def main(argv=None):
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace", "converge"],
+                            "serve", "trace", "converge", "numerics"],
                    choices=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace", "converge"])
+                            "serve", "trace", "converge", "numerics"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
@@ -233,6 +241,7 @@ def main(argv=None):
     p.add_argument("--serve-budget", type=float, default=1800.0)
     p.add_argument("--trace-budget", type=float, default=1800.0)
     p.add_argument("--converge-budget", type=float, default=1800.0)
+    p.add_argument("--numerics-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -307,6 +316,12 @@ def main(argv=None):
             [sys.executable, os.path.join(REPO, "scripts",
                                           "converge_drill.py")],
             args.converge_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "numerics" in args.legs:
+        records.append(run_leg(
+            "numerics",
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "numerics_drill.py")],
+            args.numerics_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

@@ -568,10 +568,12 @@ def test_build_converge_parser_defaults():
     args = build_converge_parser().parse_args(["runs/x"])
     assert args.run_dir == "runs/x"
     assert args.taus is None and args.bucket_by == "both"
-    assert not args.json and args.out is None
+    assert args.json is None and args.out is None
     args = build_converge_parser().parse_args(
-        ["runs/x", "--taus", "0.5", "0.1", "--bucket_by", "all", "--json"])
+        ["runs/x", "--taus", "0.5", "0.1", "--bucket_by", "all",
+         "--json", "-"])
     assert args.taus == [0.5, 0.1] and args.bucket_by == "all"
+    assert args.json == "-"
 
 
 def test_eval_serve_parsers_carry_converge_flags():
@@ -597,7 +599,7 @@ def test_cli_converge_main_on_recorded_run(tmp_path, capsys):
     tel.emit("run_end", steps=4, ok=True)
     tel.close()
     out_json = tmp_path / "table.json"
-    assert main(["converge", str(run), "--json",
+    assert main(["converge", str(run), "--json", "-",
                  "--out", str(out_json)]) == 0
     doc = json.loads(capsys.readouterr().out)
     assert doc["curves"] == 4 and doc["table"]
@@ -616,7 +618,7 @@ def test_cli_drift_v5_fires_on_seeded_converge_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 5
+    assert RULE_VERSIONS["cli-drift"] == 6
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "obs").mkdir(parents=True)
     (pkg / "cli.py").write_text(

@@ -1,0 +1,674 @@
+"""The numerics observatory (obs/numerics.py + schema v9):
+
+* the fused tap-stats vector pinned against a NumPy oracle (min/max/
+  absmean over finite values, nonfinite counts, bf16 saturation on the
+  rail, bf16 underflow on subnormal flush), under jit with the sink armed
+  inside the trace, including the duplicate-label ``#2`` suffixing;
+* the model-level numerics aux: 8 ordered refinement-scan taps riding
+  LAST in the output tuple without perturbing the flow, and the loud
+  ValueError when requested off the test_mode path;
+* the --no_numerics zero-overhead pin: numerics-off keeps the exact
+  prior HLO, a same-seed double eval run emits an identical event stream,
+  and a numerics-off train step carries no leaf_grad_norms;
+* NaN provenance: a poisoned input attributes to the dataflow-earliest
+  tap (corr_feats) at iteration 0 via taps_payload's first_nonfinite;
+* the train side: make_train_step(numerics=True) metrics gain one L2
+  norm per param leaf whose stacked global norm matches optax's;
+* payload construction + the v9 numerics lint's negative cases, and the
+  additive schema bump (v1-v8 records validate; a v8-stamped numerics
+  record flags drift);
+* eval emission on both paths (sequential and streaming: one record per
+  dispatch) and serve emission (per-dispatch taps events, per-request
+  output ranges, the slo output_range rollup, Prometheus gauges) with
+  their off-by-default pins;
+* the doctor's NONFINITE_ORIGIN > BF16_SATURATION > GRAD_EXPLOSION >
+  NUMERICS_CLEAN verdict ladder on seeded logs;
+* cli surfaces: build_numerics_parser defaults, the train/eval/serve
+  flag plumbing, `cli numerics` text + --json - modes, and cli-drift
+  rule v6 firing on a seeded orphan flag.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig, TrainConfig
+from raft_stereo_tpu.eval.stream import StreamConfig, run_frames
+from raft_stereo_tpu.inference import StereoPredictor
+from raft_stereo_tpu.models import init_model
+from raft_stereo_tpu.nn.gru import numerics_taps, record_numerics_tap
+from raft_stereo_tpu.obs import Telemetry, read_events
+from raft_stereo_tpu.obs import numerics as nm
+from raft_stereo_tpu.obs.events import make_record, validate_record
+from raft_stereo_tpu.obs.validate import (check_numerics_integrity,
+                                          check_path)
+from raft_stereo_tpu.training.optim import fetch_optimizer
+from raft_stereo_tpu.training.state import TrainState, make_train_step
+
+REPO = Path(__file__).resolve().parents[1]
+
+H, W = 32, 64
+ITERS = 3
+
+#: the refinement-scan tap labels, in trace (dataflow) order, for the
+#: tiny 3-level model — the provenance tie-break contract
+TAP_LABELS = ("corr_feats", "gru32.zr", "gru32.q", "gru16.zr", "gru16.q",
+              "gru08.zr", "gru08.q", "delta_flow")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, H, W, 3))
+    return cfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def pred_num(tiny):
+    cfg, _, variables = tiny
+    return StereoPredictor(cfg, variables, valid_iters=ITERS, numerics=True)
+
+
+@pytest.fixture(scope="module")
+def pred_off(tiny):
+    cfg, _, variables = tiny
+    return StereoPredictor(cfg, variables, valid_iters=ITERS)
+
+
+def _frame(seed, h=H, w=W):
+    rng = np.random.default_rng(seed)
+    return {"image1": rng.integers(0, 255, (h, w, 3)).astype(np.float32),
+            "image2": rng.integers(0, 255, (h, w, 3)).astype(np.float32)}
+
+
+class _Data:
+    def __init__(self, n=3, h=H, w=W, seed=0):
+        self._samples = [_frame(seed + i, h, w) for i in range(n)]
+
+    def __len__(self):
+        return len(self._samples)
+
+    def sample(self, i):
+        return self._samples[i]
+
+
+# ------------------------------------------------ tap stats vs the oracle
+
+def test_tap_stats_pin_against_numpy_oracle():
+    """The fused (6,) stats vector under jit, sink armed inside the
+    trace (sink values are tracers — arming around a jit call would leak
+    them)."""
+    def fixture(x, y):
+        with numerics_taps() as sink:
+            record_numerics_tap(x, "a")
+            record_numerics_tap(y, "a")      # duplicate label -> "a#2"
+            return dict(sink)
+
+    x = np.array([1.0, -2.0, np.nan, 0.5, np.inf, 0.0], np.float32)
+    y = np.array([3.4e38, -3.4e38, 1e-41, 4.0], np.float32)
+    out = {k: np.asarray(v) for k, v in jax.jit(fixture)(x, y).items()}
+    assert sorted(out) == ["00:a", "01:a#2"]
+
+    a = dict(zip(nm.STAT_FIELDS, out["00:a"]))
+    assert a["min"] == -2.0 and a["max"] == 1.0
+    # absmean: finite |x| summed, divided by the TOTAL element count
+    assert a["absmean"] == pytest.approx((1.0 + 2.0 + 0.5) / 6)
+    assert a["nonfinite"] == 2
+    assert a["sat"] == 1          # inf trips the rail too
+    assert a["underflow"] == 0
+
+    b = dict(zip(nm.STAT_FIELDS, out["01:a#2"]))
+    assert b["nonfinite"] == 0
+    assert b["sat"] == 2          # +/-3.4e38 both at the bf16 rail
+    assert b["underflow"] == 1    # 1e-41 flushes to bf16 zero
+    assert b["min"] == np.float32(-3.4e38) and b["max"] == np.float32(3.4e38)
+
+    # no armed sink: the tap is the identity and records nothing
+    z = np.ones((2,), np.float32)
+    assert record_numerics_tap(z, "idle") is z
+
+
+def test_all_nonfinite_tensor_yields_inf_sentinels():
+    def fixture(x):
+        with numerics_taps() as sink:
+            record_numerics_tap(x, "dead")
+            return dict(sink)
+
+    (key, stats), = jax.jit(fixture)(
+        np.full((3,), np.nan, np.float32)).items()
+    s = dict(zip(nm.STAT_FIELDS, np.asarray(stats)))
+    assert key == "00:dead"
+    assert np.isinf(s["min"]) and np.isinf(s["max"])     # host -> null
+    assert s["nonfinite"] == 3
+    # and taps_payload cleans the sentinels to null
+    payload = nm.taps_payload("eval:t", {key: np.asarray(stats)[None]})
+    series = payload["taps"]["dead"]
+    assert series["min"] == [None] and series["max"] == [None]
+    assert payload["first_nonfinite"] == {"tap": "dead", "iter": 0,
+                                          "count": 3}
+
+
+# --------------------------------------------------- model-level numerics
+
+def test_model_numerics_aux_rides_last_without_perturbing_flow(tiny):
+    cfg, model, variables = tiny
+    s = _frame(7)
+    im1, im2 = s["image1"][None], s["image2"][None]
+    out = model.apply(variables, im1, im2, iters=ITERS, test_mode=True,
+                      numerics=True)
+    flow_lr, flow_up, taps = out
+    labels = [nm.split_label(k)[1] for k in sorted(taps)]
+    assert tuple(labels) == TAP_LABELS
+    for k, v in taps.items():
+        assert np.asarray(v).shape == (ITERS, len(nm.STAT_FIELDS)), k
+    # sorted-key flattening preserves trace order via the 2-digit prefix
+    orders = [nm.split_label(k)[0] for k in sorted(taps)]
+    assert orders == list(range(len(TAP_LABELS)))
+    # the aux rides along without perturbing the prediction
+    _, up_plain = model.apply(variables, im1, im2, iters=ITERS,
+                              test_mode=True)
+    np.testing.assert_array_equal(np.asarray(up_plain), np.asarray(flow_up))
+    # healthy inputs: no nonfinite anywhere, finite ranges everywhere
+    payload = nm.taps_payload(
+        "eval:t", {k: np.asarray(v) for k, v in taps.items()})
+    assert payload["iters"] == ITERS
+    assert payload["first_nonfinite"] is None
+    assert payload["underflow_total"] >= 0
+
+
+def test_numerics_off_test_mode_path_is_loud(tiny):
+    _, model, variables = tiny
+    s = _frame(3)
+    with pytest.raises(ValueError, match="test_mode"):
+        model.apply(variables, s["image1"][None], s["image2"][None],
+                    iters=2, numerics=True)
+
+
+def test_no_numerics_keeps_the_exact_prior_hlo(tiny):
+    cfg, model, variables = tiny
+    spec = jax.ShapeDtypeStruct((1, H, W, 3), np.float32)
+    vspec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), variables)
+
+    def run_off(v, a, b):
+        return model.apply(v, a, b, iters=ITERS, test_mode=True,
+                           numerics=False)
+
+    def run_prior(v, a, b):
+        return model.apply(v, a, b, iters=ITERS, test_mode=True)
+
+    run_off.__name__ = run_prior.__name__ = "forward"
+    text_off = jax.jit(run_off).lower(vspec, spec, spec).as_text()
+    text_prior = jax.jit(run_prior).lower(vspec, spec, spec).as_text()
+    assert text_off == text_prior
+
+
+def test_nan_provenance_attributes_earliest_tap(tiny):
+    """A NaN-poisoned input shows up at the dataflow-earliest tap
+    (corr_feats) of iteration 0 — not at whichever downstream tap
+    happens to sort first."""
+    cfg, model, variables = tiny
+    s = _frame(11)
+    im1 = s["image1"][None].copy()
+    im1[0, H // 2, W // 2, :] = np.nan
+    _, _, taps = model.apply(variables, im1, s["image2"][None],
+                             iters=ITERS, test_mode=True, numerics=True)
+    payload = nm.taps_payload(
+        "eval:things", {k: np.asarray(v) for k, v in taps.items()},
+        bucket=f"{H}x{W}", frame=0)
+    fn = payload["first_nonfinite"]
+    assert fn is not None
+    assert fn["tap"] == "corr_feats" and fn["iter"] == 0
+    assert nm.alarm(payload) == "nonfinite_tap"
+    # the record round-trips schema + referential lint
+    rec = make_record("numerics", t=1.0, **payload)
+    assert validate_record(rec) == []
+    assert check_numerics_integrity([rec]) == []
+
+
+# -------------------------------------------------------- the train side
+
+def test_train_step_leaf_grad_norms(tiny):
+    cfg, model, variables = tiny
+    tx = fetch_optimizer(TrainConfig(num_steps=10, batch_size=2))
+    state = TrainState.create(variables, tx)
+    rng = np.random.default_rng(0)
+    batch = {
+        "image1": np.asarray(rng.uniform(0, 255, (2, H, W, 3)), np.float32),
+        "image2": np.asarray(rng.uniform(0, 255, (2, H, W, 3)), np.float32),
+        "flow": np.asarray(rng.uniform(-8, 0, (2, H, W, 1)), np.float32),
+        "valid": np.ones((2, H, W), np.float32),
+    }
+    step = jax.jit(make_train_step(model, tx, train_iters=2, numerics=True))
+    _, metrics = step(state, batch)
+    norms = np.asarray(metrics["leaf_grad_norms"])
+    names = nm.grad_leaf_names(variables["params"])
+    assert norms.shape == (len(names),)
+    assert np.all(np.isfinite(norms)) and np.all(norms >= 0)
+    # the stacked per-leaf vector recomposes optax's global norm
+    assert float(np.sqrt(np.sum(norms ** 2))) == pytest.approx(
+        float(metrics["grad_norm"]), rel=1e-5)
+    # numerics off: the metrics dict stays exactly as before
+    step_off = jax.jit(make_train_step(model, tx, train_iters=2))
+    _, m_off = step_off(state, batch)
+    assert "leaf_grad_norms" not in m_off
+
+    payload = nm.grad_payload(50, names, norms)
+    assert payload["kind"] == "grad" and payload["step"] == 50
+    assert len(payload["top"]) == nm.TOP_K
+    assert nm.alarm(payload) is None      # healthy tiny step
+    rec = make_record("numerics", t=1.0, **payload)
+    assert validate_record(rec) == []
+    assert check_numerics_integrity([rec]) == []
+
+
+def test_top_leaves_ranks_nonfinite_first():
+    names = ["a", "b", "c", "d"]
+    norms = [1.0, float("nan"), 50.0, 2.0]
+    top = nm.top_leaves(names, norms, k=3)
+    assert top == [("b", None), ("c", 50.0), ("d", 2.0)]
+    assert nm.alarm(nm.grad_payload(1, names, norms)) \
+        == "nonfinite_grad_leaf"
+    assert nm.alarm(nm.grad_payload(1, ["a"], [nm.GRAD_ALARM_NORM * 2])) \
+        == "grad_explosion"
+
+
+# ------------------------------------------- lint + schema v9 additivity
+
+def _tap_rec(**kw):
+    series = {f: [0.0, 0.0] for f in nm.STAT_FIELDS}
+    base = dict(source="eval:t", kind="taps", iters=2,
+                taps={"delta_flow": series}, sat_total=0,
+                underflow_total=0, first_nonfinite=None)
+    base.update(kw)
+    return make_record("numerics", t=1.0, **base)
+
+
+def test_numerics_lint_catches_malformed_records():
+    assert check_numerics_integrity([_tap_rec()]) == []
+    bad_len = {f: [0.0] for f in nm.STAT_FIELDS}
+    assert any("not length iters" in e for e in check_numerics_integrity(
+        [_tap_rec(taps={"delta_flow": bad_len})]))
+    neg = {f: ([0.0, -1.0] if f == "sat" else [0.0, 0.0])
+           for f in nm.STAT_FIELDS}
+    assert any("negative sat" in e for e in check_numerics_integrity(
+        [_tap_rec(taps={"delta_flow": neg})]))
+    assert any("unknown tap" in e for e in check_numerics_integrity(
+        [_tap_rec(first_nonfinite={"tap": "ghost", "iter": 0})]))
+    assert any("outside" in e for e in check_numerics_integrity(
+        [_tap_rec(first_nonfinite={"tap": "delta_flow", "iter": 5})]))
+    assert any("not positive" in e for e in check_numerics_integrity(
+        [_tap_rec(first_nonfinite={"tap": "delta_flow", "iter": 0})]))
+    grad = make_record("numerics", t=1.0, source="train", kind="grad",
+                       step=1, leaves=["a", "b"], grad_norm=[1.0])
+    assert any("2 leaves vs 1" in e
+               for e in check_numerics_integrity([grad]))
+    assert any("numbers or null" in e for e in check_numerics_integrity(
+        [dict(grad, grad_norm=["nan", 1.0])]))
+    assert any("unknown kind" in e for e in check_numerics_integrity(
+        [make_record("numerics", t=1.0, source="t", kind="mystery")]))
+
+
+def test_schema_v9_additive_and_v8_stamp_is_drift():
+    good = _tap_rec()
+    assert validate_record(good) == []
+    stale = dict(good, schema=8)
+    assert any("introduced in schema 9" in e for e in validate_record(stale))
+    # pre-v9 records validate against their own surface (additive bump)
+    for ver, event, payload in [
+            (5, "anomaly", dict(kind="nonfinite_grad")),
+            (7, "span", dict(name="x", span_id="s1", trace_id="t1",
+                             start_s=0.0, dur_s=0.1)),
+            (8, "converge", dict(source="eval:t", iters=2, idx=[0, 1],
+                                 residual=[1.0, 0.1]))]:
+        rec = dict(make_record(event, t=1.0, **payload), schema=ver)
+        assert validate_record(rec) == [], (ver, event)
+    # the v9 request/slo output-range extras ride along additively
+    slo = make_record("slo", t=1.0, p50_ms=1.0, p99_ms=2.0,
+                      pairs_per_sec=3.0, in_flight=1,
+                      output_range={"32x64": {"output_min_p05": -8.0,
+                                              "output_max_p95": 0.1,
+                                              "n": 4}})
+    assert validate_record(slo) == []
+
+
+# ----------------------------------------------- eval emission + the pin
+
+def _eval_run(tmp_path, name, ds, predictor, stream):
+    tel = Telemetry(str(tmp_path / name), stall_deadline_s=None)
+    tel.run_start(config={"mode": "eval"})
+    run_frames(predictor, ds, lambda *a: None, iters=ITERS,
+               stream=stream, telemetry=tel, source="things")
+    tel.emit("run_end", steps=tel.steps, ok=True)
+    tel.close()
+    return read_events(str(tmp_path / name / "events.jsonl"))
+
+
+def test_eval_emits_numerics_both_paths(tmp_path, pred_num):
+    ds = _Data(n=3)
+    assert pred_num.numerics
+    seq = _eval_run(tmp_path, "seq", ds, pred_num, stream=False)
+    st = _eval_run(tmp_path, "stream", ds, pred_num,
+                   stream=StreamConfig(enabled=True, window=2,
+                                       microbatch=2))
+    # one record per DISPATCH: 3 sequential singles, 2 microbatches
+    for name, events, n in (("seq", seq, 3), ("stream", st, 2)):
+        recs = [e for e in events if e.get("event") == "numerics"]
+        assert len(recs) == n, name
+        for r in recs:
+            assert r["kind"] == "taps" and r["source"] == "eval:things"
+            assert r["bucket"] == f"{H}x{W}" and "frame" in r
+            assert tuple(r["taps"]) == TAP_LABELS
+            assert r["iters"] == ITERS
+            assert r["first_nonfinite"] is None
+        assert check_path(str(tmp_path / name)) == []
+    # the recorded run replays into the offline report
+    doc = nm.build_report("stream", nm.load_records(str(tmp_path /
+                                                        "stream")))
+    assert doc["tap_events"] == 2 and doc["grad_events"] == 0
+    assert [r["tap"] for r in doc["taps"]] == list(TAP_LABELS)
+    assert doc["first_nonfinite"] == []
+
+
+def test_no_numerics_double_run_is_byte_identical(tmp_path, pred_off):
+    ds = _Data(n=2)
+    ev1 = _eval_run(tmp_path, "off1", ds, pred_off, stream=False)
+    ev2 = _eval_run(tmp_path, "off2", ds, pred_off, stream=False)
+
+    def scrub(events):
+        return [{k: v for k, v in e.items()
+                 if k not in ("t", "ts", "run", "path", "data_wait_s",
+                              "dispatch_s", "fetch_s")}
+                for e in events if e.get("event") != "compile"]
+
+    assert scrub(ev1) == scrub(ev2)
+    assert [e for e in ev1 if e.get("event") == "numerics"] == []
+    assert pred_off.take_aux() is None
+
+
+def test_predictor_numerics_aux_fetch(pred_num, pred_off):
+    s = _frame(9)
+    flow = pred_num(s["image1"][None], s["image2"][None], ITERS)
+    assert flow.shape == (1, H, W, 1)
+    aux = pred_num.take_aux()
+    assert "numerics" in aux
+    taps = aux["numerics"]
+    assert [nm.split_label(k)[1] for k in sorted(taps)] == list(TAP_LABELS)
+    assert pred_num.take_aux() is None          # popped once
+    # numerics never perturbs the flow vs the off flavor
+    np.testing.assert_array_equal(
+        np.asarray(pred_off(s["image1"][None], s["image2"][None], ITERS)),
+        np.asarray(flow))
+
+
+# --------------------------------------- serve: taps events + output range
+
+class _Fake5Cache:
+    """Fake converge+numerics flavor: 5 outputs, the taps dict LAST."""
+
+    def __len__(self):
+        return 1
+
+    def __call__(self, key, im1, im2, flow_init=None):
+        b, h, w, _ = im1.shape
+        deltas = np.linspace(1.0, 0.01, key.iters)[:, None].repeat(b, 1)
+        stats = np.zeros((key.iters, len(nm.STAT_FIELDS)), np.float32)
+        stats[:, 0], stats[:, 1], stats[:, 2] = -8.0, 7.0, 3.0
+        taps = {f"{i:02d}:{label}": stats.copy()
+                for i, label in enumerate(TAP_LABELS)}
+        return (np.zeros((b, h // 4, w // 4, 2), np.float32),
+                np.full((b, h, w, 1), 7.0, np.float32),
+                np.ones((b,), bool),
+                deltas.astype(np.float32),
+                taps)
+
+
+def _serve_run(tmp_path, name, cache, **cfg_kw):
+    from raft_stereo_tpu.serve import ServeConfig, StereoServer
+    tel = Telemetry(str(tmp_path / name), stall_deadline_s=None)
+    tel.run_start(config={"mode": "serve"})
+    stub_vars = {"params": {"w": np.zeros((1,), np.float32)}}
+    server = StereoServer(
+        RAFTStereoConfig(), stub_vars,
+        ServeConfig(max_batch=2, window=2, default_iters=4, linger_s=0.0,
+                    slo_every=1, **cfg_kw),
+        telemetry=tel, autostart=False)
+    server.cache = cache
+    server.start()
+    rng = np.random.default_rng(0)
+    results = []
+    for i in range(3):
+        left = rng.random((H, W, 3)).astype(np.float32)
+        right = rng.random((H, W, 3)).astype(np.float32)
+        results.append(server.submit(left, right).result(timeout=60))
+    server.request_drain()
+    assert server.join(timeout=60)
+    stats = server.stats()
+    tel.emit("run_end", steps=3, ok=True)
+    tel.close()
+    return results, stats, read_events(str(tmp_path / name /
+                                           "events.jsonl"))
+
+
+def test_serve_numerics_events_and_output_range(tmp_path):
+    from raft_stereo_tpu.serve.http import prometheus_metrics
+    results, stats, events = _serve_run(tmp_path, "serve", _Fake5Cache(),
+                                        numerics=True)
+    assert all(r.ok for r in results)
+    # converge still rides in slot 3 with the taps LAST
+    assert all(r.final_residual == pytest.approx(0.01) for r in results)
+    assert all(r.output_min == pytest.approx(7.0)
+               and r.output_max == pytest.approx(7.0) for r in results)
+    recs = [e for e in events if e.get("event") == "numerics"]
+    assert recs and all(r["kind"] == "taps" for r in recs)
+    for r in recs:
+        assert r["source"].startswith("serve:")
+        assert r["bucket"].count("x") == 1 and r["id"].startswith("r")
+        assert tuple(r["taps"]) == TAP_LABELS
+    reqs = [e for e in events if e.get("event") == "request"]
+    assert all(r["output_min"] == pytest.approx(7.0) for r in reqs)
+    assert all(r["output_max"] == pytest.approx(7.0) for r in reqs)
+    (bucket, rng_), = stats["output_range"].items()
+    assert rng_["n"] == 3
+    assert rng_["output_min_p05"] == pytest.approx(7.0)
+    assert rng_["output_max_p95"] == pytest.approx(7.0)
+    assert check_path(str(tmp_path / "serve")) == []
+    text = prometheus_metrics(stats)
+    assert f'raft_serve_output_min_p05{{bucket="{bucket}"}}' in text
+    assert f'raft_serve_output_max_p95{{bucket="{bucket}"}}' in text
+    assert f'raft_serve_output_range_window_requests{{bucket="{bucket}"}}' \
+        in text
+
+
+def test_serve_numerics_off_emits_nothing_extra(tmp_path):
+    from raft_stereo_tpu.serve.http import prometheus_metrics
+    from test_converge import _Fake4Cache
+    results, stats, events = _serve_run(tmp_path, "off", _Fake4Cache())
+    assert all(r.ok and r.output_min is None and r.output_max is None
+               for r in results)
+    assert [e for e in events if e.get("event") == "numerics"] == []
+    assert "output_range" not in stats
+    assert all("output_min" not in e for e in events
+               if e.get("event") == "request")
+    assert "output_range" not in prometheus_metrics(stats)
+
+
+def test_serve_numerics_defaults_off():
+    from raft_stereo_tpu.serve import ServeConfig
+    from raft_stereo_tpu.serve.cache import ExecutableCache
+    assert ServeConfig().numerics is False      # serve opts IN
+    stub = {"params": {"w": np.zeros((1,), np.float32)}}
+    assert ExecutableCache(RAFTStereoConfig(), stub).numerics is False
+
+
+# --------------------------------------------------- the doctor's ladder
+
+def _numerics_log(tmp_path, name, payloads):
+    run = tmp_path / name
+    tel = Telemetry(str(run), stall_deadline_s=None)
+    tel.run_start(config={})
+    for p in payloads:
+        tel.emit("numerics", **p)
+    tel.emit("run_end", steps=len(payloads), ok=True)
+    tel.close()
+    return str(run)
+
+
+def _sat_payload(sat=0.0, nonfinite=0.0, tap="gru08.q"):
+    stats = np.zeros((2, len(nm.STAT_FIELDS)))
+    stats[1, nm.STAT_FIELDS.index("sat")] = sat
+    stats[1, nm.STAT_FIELDS.index("nonfinite")] = nonfinite
+    return nm.taps_payload("eval:t", {f"03:{tap}": stats}, frame=0)
+
+
+def test_doctor_numerics_verdict_ladder(tmp_path):
+    from raft_stereo_tpu.obs.doctor import diagnose
+
+    def verdict(run):
+        return next(v for v in diagnose(run)["verdicts"]
+                    if v["phase"] == "numerics")
+
+    # a NaN origin trumps a saturation record in the same run
+    run = _numerics_log(tmp_path, "nan", [
+        _sat_payload(sat=5.0),
+        _sat_payload(nonfinite=3.0, tap="corr_feats")])
+    v = verdict(run)
+    assert v["verdict"] == "NONFINITE_ORIGIN"
+    assert any("corr_feats" in e for e in v["evidence"])
+    assert any("cli numerics" in e for e in v["evidence"])
+
+    # a null grad-norm leaf is also an origin
+    names, norms = ["enc/w", "gru/w"], [1.0, float("nan")]
+    run = _numerics_log(tmp_path, "grad_nan",
+                        [nm.grad_payload(7, names, norms)])
+    v = verdict(run)
+    assert v["verdict"] == "NONFINITE_ORIGIN"
+    assert any("gru/w" in e for e in v["evidence"])
+
+    # saturation outranks a (finite) explosion
+    run = _numerics_log(tmp_path, "sat", [
+        _sat_payload(sat=5.0),
+        nm.grad_payload(7, ["w"], [nm.GRAD_ALARM_NORM * 2])])
+    v = verdict(run)
+    assert v["verdict"] == "BF16_SATURATION"
+    assert any("gru08.q" in e for e in v["evidence"])
+
+    run = _numerics_log(tmp_path, "boom", [
+        nm.grad_payload(7, ["w"], [nm.GRAD_ALARM_NORM * 2])])
+    assert verdict(run)["verdict"] == "GRAD_EXPLOSION"
+
+    run = _numerics_log(tmp_path, "clean", [_sat_payload()])
+    assert verdict(run)["verdict"] == "NUMERICS_CLEAN"
+
+    # no numerics events at all: the phase stays silent (pre-v9 runs)
+    run = _numerics_log(tmp_path, "silent", [])
+    assert all(v["phase"] != "numerics" for v in diagnose(run)["verdicts"])
+
+
+# ------------------------------------------------- cli surfaces + drift
+
+def test_build_numerics_parser_defaults():
+    from raft_stereo_tpu.cli import build_numerics_parser
+    args = build_numerics_parser().parse_args(["runs/x"])
+    assert args.run_dir == "runs/x"
+    assert args.top == 10 and args.json is None
+    args = build_numerics_parser().parse_args(
+        ["runs/x", "--top", "3", "--json", "-"])
+    assert args.top == 3 and args.json == "-"
+
+
+def test_train_eval_serve_parsers_carry_numerics_flags():
+    from raft_stereo_tpu.cli import (build_eval_parser, build_serve_parser,
+                                     build_train_parser, serve_config,
+                                     train_config)
+    args = build_train_parser().parse_args([])
+    cfg = train_config(args)
+    assert cfg.numerics is True and cfg.numerics_every == 50
+    cfg = train_config(build_train_parser().parse_args(
+        ["--no_numerics", "--numerics_every", "7"]))
+    assert cfg.numerics is False and cfg.numerics_every == 7
+    args = build_eval_parser().parse_args(["--dataset", "things"])
+    assert not args.no_numerics
+    assert serve_config(build_serve_parser().parse_args([])).numerics \
+        is False
+    assert serve_config(build_serve_parser().parse_args(
+        ["--numerics"])).numerics is True
+
+
+def test_cli_numerics_main_on_recorded_run(tmp_path, capsys):
+    from raft_stereo_tpu.cli import main
+    run = _numerics_log(tmp_path, "run", [
+        _sat_payload(sat=2.0),
+        nm.grad_payload(50, ["enc/w", "gru/w"], [1.0, 0.5])])
+    assert main(["numerics", str(run), "--json", "-"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["grad_events"] == 1 and doc["tap_events"] == 1
+    assert doc["saturation"][0]["tap"] == "gru08.q"
+    assert main(["numerics", str(run)]) == 0
+    text = capsys.readouterr().out
+    assert "bf16 saturation leaderboard" in text and "gru08.q" in text
+    # empty run dir: loud exit 1
+    assert main(["numerics", str(tmp_path / "empty")]) == 1
+    assert "no numerics records" in capsys.readouterr().err
+    # the command is advertised
+    assert main([]) == 2
+
+
+def test_cli_drift_v6_fires_on_seeded_numerics_fixture(tmp_path):
+    from raft_stereo_tpu.analysis.ast_rules import (
+        RULE_VERSIONS, check_entry_surface_drift)
+
+    assert RULE_VERSIONS["cli-drift"] == 6
+    pkg = tmp_path / "raft_stereo_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "cli.py").write_text(
+        "def build_numerics_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('run_dir')\n"
+        "    p.add_argument('--top')\n"
+        "    p.add_argument('--numerics_orphan')\n"
+        "    return p\n")
+    (pkg / "obs" / "numerics.py").write_text(
+        "def main(args):\n"
+        "    return (args.run_dir, args.top)\n")
+    findings = check_entry_surface_drift(str(tmp_path))
+    errors = [f for f in findings
+              if f.rule == "cli-drift" and f.severity == "error"]
+    assert {f.data.get("dest") for f in errors} == {"numerics_orphan"}
+    assert {f.data.get("surface")
+            for f in errors} == {"build_numerics_parser"}
+
+
+# ------------------------------------------------- report helper pins
+
+def test_report_helpers_pins():
+    assert nm.split_label("03:gru16.zr") == (3, "gru16.zr")
+    assert nm.split_label("bare")[1] == "bare"
+    records = [
+        dict(nm.grad_payload(0, ["a", "b"], [1.0, 2.0]), event="numerics"),
+        dict(nm.grad_payload(100, ["a", "b"], [4.0, float("nan")]),
+             event="numerics"),
+    ]
+    rows = nm.leaf_trend(records)
+    assert rows[0]["leaf"] == "b" and rows[0]["nonfinite_steps"] == [100]
+    assert rows[1]["leaf"] == "a"
+    assert rows[1]["first"] == 1.0 and rows[1]["last"] == 4.0
+    assert rows[1]["growth"] == pytest.approx(4.0)
+    taps = [dict(_sat_payload(sat=3.0), event="numerics"),
+            dict(_sat_payload(sat=1.0, tap="corr_feats"),
+                 event="numerics")]
+    trend = nm.tap_trend(taps)
+    board = nm.saturation_leaderboard(trend)
+    assert [r["tap"] for r in board] == ["gru08.q", "corr_feats"]
+    nf = nm.first_nonfinite_report(
+        [dict(_sat_payload(nonfinite=2.0, tap="corr_feats"),
+              event="numerics"),
+         dict(nm.grad_payload(9, ["w"], [float("inf")]),
+              event="numerics")])
+    assert nf[0]["tap"] == "corr_feats" and nf[0]["iter"] == 1
+    assert nf[1]["kind"] == "grad" and nf[1]["step"] == 9
