@@ -51,13 +51,17 @@ from raft_stereo_tpu.analysis.findings import Finding
 #: --no_converge/--iter_epe plumbing on the eval and serve surfaces; v6
 #: adds the numerics surface (build_numerics_parser, consumed by
 #: obs/numerics.py) plus the --no_numerics/--numerics_every/--numerics
-#: plumbing on the train, eval and serve surfaces — so earlier
-#: suppressions no longer mean what they said.
+#: plumbing on the train, eval and serve surfaces; v7 adds the adaptive-
+#: iteration plumbing — --iter_policy on the eval surface, --iter_policy/
+#: --adaptive on the serve/loadtest surfaces, and the policy-emission
+#: flags (--emit-policy/--policy-tau/--policy-min-iters/--policy-margin)
+#: on the converge surface — so earlier suppressions no longer mean what
+#: they said.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 6,
+    "cli-drift": 7,
 }
 
 # Call names (last attribute segment) that trace their function arguments.

@@ -20,7 +20,10 @@ this module makes them declarative rules over two canonical lowerings:
   production code, so host-sync/dtype/donation contracts must hold over it
   too — in particular that the cond does not break state donation (the
   aliasing is re-verified on the compiled executable every lint run);
-* ``inference`` — the ``test_mode`` forward ``StereoPredictor`` jits.
+* ``inference`` — the ``test_mode`` forward ``StereoPredictor`` jits;
+* ``inference[adaptive]`` — the compiled early-exit flavor (masked
+  fixed-trip scan with per-sample freeze, models/raft_stereo.py
+  ``_refine_adaptive``) the ``--iter_policy`` eval/serve paths run.
 
 Same jaxpr topology as the real shapes (shape enters only aval sizes), so
 every placement/dtype/callback contract checked here holds for the TPU
@@ -552,6 +555,20 @@ def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
     targets.append(GraphTarget(
         name="inference", cfg=base,
         closed_jaxpr=jax.make_jaxpr(infer)(variables, img1, img2),
+        platform=platform))
+
+    # 5) adaptive inference forward (the compiled early-exit flavor the
+    # iter_policy path serves, models/raft_stereo.py _refine_adaptive —
+    # masked fixed-trip scan, so carry-growth/collective rules see the
+    # same static-shape program the AOT serve cache compiles)
+    def infer_adaptive(v, a, b):
+        return model.apply(v, a, b, iters=iters, test_mode=True,
+                           iter_metrics="per_sample", adaptive_tau=0.05,
+                           adaptive_min_iters=1)
+
+    targets.append(GraphTarget(
+        name="inference[adaptive]", cfg=base,
+        closed_jaxpr=jax.make_jaxpr(infer_adaptive)(variables, img1, img2),
         platform=platform))
     return targets
 

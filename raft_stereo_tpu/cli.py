@@ -302,6 +302,12 @@ def build_eval_parser() -> argparse.ArgumentParser:
                    help="additionally compute the in-graph per-iteration "
                         "EPE against GT (needs datasets with flow; implies "
                         "the convergence aux)")
+    c.add_argument("--iter_policy", default=None, metavar="PATH",
+                   help="iteration-policy JSON (`cli converge --emit-policy`)"
+                        ": run the COMPILED early-exit forward with each "
+                        "bucket's recorded (tau, budget, min_iters) instead "
+                        "of the fixed valid_iters trip; per-frame "
+                        "iters_taken rides the converge events")
     n = parser.add_argument_group(
         "numerics", "per-iteration activation-tap range statistics "
         "(obs/numerics.py): min/max/absmean, bf16 saturation/underflow "
@@ -366,6 +372,19 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                         "per-bucket output-range drift gauges on the "
                         "Prometheus /metrics endpoint; OFF by default — "
                         "the served program stays byte-identical without it")
+    g.add_argument("--iter_policy", default=None, metavar="PATH",
+                   help="iteration-policy JSON (`cli converge "
+                        "--emit-policy`): serve the compiled early-exit "
+                        "flavors — per-bucket (tau, budget, min_iters) "
+                        "replace --iters where the policy covers the "
+                        "bucket; per-request iters_taken rides the "
+                        "request/slo telemetry and /metrics")
+    g.add_argument("--adaptive", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="early-exit execution mode (auto: on iff "
+                        "--iter_policy is given; off ignores a loaded "
+                        "policy and serves the fixed-trip programs — the "
+                        "bitwise pre-adaptive pin)")
 
 
 def serve_config(args: argparse.Namespace):
@@ -375,7 +394,8 @@ def serve_config(args: argparse.Namespace):
         window=args.window, default_iters=args.iters, bucket=args.bucket,
         linger_s=args.linger_ms / 1e3, aot=not args.no_aot,
         slo_every=args.slo_every, converge=not args.no_converge,
-        numerics=args.numerics)
+        numerics=args.numerics, iter_policy=args.iter_policy,
+        adaptive={"auto": None, "on": True, "off": False}[args.adaptive])
 
 
 def _parse_shapes(specs) -> list:
@@ -470,6 +490,23 @@ def build_converge_parser() -> argparse.ArgumentParser:
                              "converge_drill's replay leg parses this)")
     parser.add_argument("--out", default=None,
                         help="also write the JSON table to this path")
+    p = parser.add_argument_group(
+        "policy emission", "freeze one simulated operating point into a "
+        "checked-in iter_policy.json artifact — per-bucket (tau, budget, "
+        "min_iters) with row provenance — that eval (--iter_policy), serve "
+        "(--iter_policy) and the AOT cache compile in as the early-exit "
+        "execution mode (schema lint: scripts/check_events.py)")
+    p.add_argument("--emit-policy", default=None, metavar="PATH",
+                   help="write the policy JSON here (the decision table "
+                        "still prints)")
+    p.add_argument("--policy-tau", type=float, default=None,
+                   help="exit threshold frozen into the policy (px mean "
+                        "|delta disparity|; default: the doctor's 0.05)")
+    p.add_argument("--policy-min-iters", type=int, default=1,
+                   help="iteration floor before a sample may freeze")
+    p.add_argument("--policy-margin", type=int, default=1,
+                   help="budget = recorded exit p95 + this safety margin "
+                        "(clamped to the recorded valid_iters)")
     return parser
 
 
@@ -552,7 +589,9 @@ def _serve_main():
         Tracer(tel)  # request-lifecycle spans (attaches as tel.tracer)
         tel.run_start(config={"mode": "serve", "port": args.port,
                               "max_batch": args.max_batch,
-                              "window": args.window, "iters": args.iters})
+                              "window": args.window, "iters": args.iters,
+                              "iter_policy": args.iter_policy,
+                              "adaptive": args.adaptive})
     server = StereoServer(cfg, variables, serve_config(args), telemetry=tel)
     if args.warm_shapes:
         n = server.warmup(_parse_shapes(args.warm_shapes),
@@ -622,7 +661,9 @@ def _loadtest_main():
                           "video_streams": args.video_streams,
                           "poison_at": args.poison_at,
                           "max_batch": args.max_batch,
-                          "window": args.window, "iters": args.iters}}
+                          "window": args.window, "iters": args.iters,
+                          "iter_policy": args.iter_policy,
+                          "adaptive": args.adaptive}}
     if not args.no_baseline:
         with Telemetry(os.path.join(args.run_dir, "seq"),
                        stall_deadline_s=None) as tel_seq:
@@ -717,11 +758,17 @@ def _eval_main():
         args.mixed_precision = True
     cfg = model_config(args)
     _, variables = load_variables(args.restore_ckpt, cfg)
+    if args.iter_policy and not args.no_numerics:
+        # the adaptive path carries no numerics taps (inference.py guard)
+        logging.getLogger(__name__).info(
+            "disabling numerics taps for --iter_policy run")
     predictor = StereoPredictor(cfg, variables, valid_iters=args.valid_iters,
                                 bucket=args.bucket,
                                 converge=not args.no_converge,
                                 iter_epe=args.iter_epe,
-                                numerics=not args.no_numerics)
+                                numerics=(not args.no_numerics
+                                          and not args.iter_policy),
+                                iter_policy=args.iter_policy)
     from raft_stereo_tpu.eval.stream import StreamConfig
     stream = StreamConfig(
         enabled={"auto": None, "on": True, "off": False}[args.stream],
@@ -738,7 +785,9 @@ def _eval_main():
                               "stream_microbatch": args.stream_microbatch,
                               "converge": not args.no_converge,
                               "iter_epe": args.iter_epe,
-                              "numerics": not args.no_numerics})
+                              "numerics": not args.no_numerics,
+                              "iter_policy": args.iter_policy,
+                              "iter_policy_digest": predictor.policy_digest})
     try:
         if args.dataset.startswith("middlebury_"):
             results = validate_middlebury(predictor, args.data_root,

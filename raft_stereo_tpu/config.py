@@ -162,6 +162,17 @@ class RAFTStereoConfig:
     # save-policy size estimate (refinement_save_policy_fits), so bf16
     # residuals can re-admit the policy at shapes fp32 saves priced out.
     residual_dtype: Optional[str] = None
+    # Ours: mechanism for the adaptive early-exit inference mode (engaged
+    # per-call via adaptive_tau, test mode only; the thresholds/budgets
+    # come from a recorded iter_policy — obs/converge.py). "masked_scan"
+    # keeps the fixed-trip nn.scan and freezes converged samples in the
+    # carry (static shapes/trip count — the AOT/serve-cache flavor; saved
+    # wall clock comes from the policy's per-bucket budget undercutting
+    # the fixed valid_iters). "while_loop" exits the whole batch as soon
+    # as every sample has converged (dynamic trip count — wins when a
+    # whole batch settles early, but the program is not expressible as a
+    # fixed-length scan).
+    adaptive_mode: str = "masked_scan"
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
@@ -205,6 +216,10 @@ class RAFTStereoConfig:
             raise ValueError(
                 f"batched_scan_wgrad must be None (auto), True or False, "
                 f"got {self.batched_scan_wgrad!r}")
+        if self.adaptive_mode not in ("masked_scan", "while_loop"):
+            raise ValueError(
+                f"adaptive_mode must be 'masked_scan' or 'while_loop', "
+                f"got {self.adaptive_mode!r}")
         if len(self.hidden_dims) != 3 or self.hidden_dims[0] != self.hidden_dims[2]:
             # The reference wires context conv i (sized hidden_dims[i]) into the
             # GRU at level i whose hidden size is hidden_dims[2-i]

@@ -183,7 +183,12 @@ def _emit_numerics(telemetry, source, sample, aux, index) -> None:
 
 
 def _emit_converge(telemetry, source, sample, aux, j, index) -> None:
-    """One frame's ``converge`` record from a (possibly batched) aux."""
+    """One frame's ``converge`` record from a (possibly batched) aux.
+
+    Adaptive predictors (``iter_policy=``, inference.py) add the per-sample
+    ``iters_taken`` as an extra on the same record — the production loop's
+    evidence that the compiled early exit actually saved iterations (and
+    the doctor's OVER_ITERATED verdict input)."""
     if telemetry is None or aux is None or "residual" not in aux:
         return
     from raft_stereo_tpu.obs import converge as converge_obs
@@ -193,9 +198,14 @@ def _emit_converge(telemetry, source, sample, aux, j, index) -> None:
     if epe is not None:
         epe = np.asarray(epe)
         epe = epe[:, j] if epe.ndim == 2 else epe
+    extra = {}
+    taken = aux.get("iters_taken")
+    if taken is not None:
+        arr = np.asarray(taken)
+        extra["iters_taken"] = int(arr[j] if arr.ndim else arr)
     h, w = sample["image1"].shape[:2]
     converge_obs.emit(telemetry, source, len(res), res, epe=epe,
-                      bucket=f"{h}x{w}", frame=index)
+                      bucket=f"{h}x{w}", frame=index, **extra)
 
 
 def _run_sequential(predictor, dataset, consume, iters, telemetry, timed,

@@ -126,12 +126,39 @@ class StereoPredictor:
     def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
                  valid_iters: int = 32, bucket: int = 0,
                  converge: bool = False, iter_epe: bool = False,
-                 numerics: bool = False):
+                 numerics: bool = False, iter_policy=None,
+                 adaptive: Optional[bool] = None):
         self.cfg = cfg
         self.model = create_model(cfg)
         self.variables = variables
         self.valid_iters = valid_iters
         self.bucket = bucket
+        #: recorded iteration policy (obs/converge.py iter_policy.json):
+        #: a path or a pre-loaded doc. Loading LINTS it — a doctored
+        #: policy fails here, not at dispatch.
+        self._policy = None
+        self.policy_digest: Optional[str] = None
+        if iter_policy is not None:
+            from raft_stereo_tpu.obs.converge import (load_policy,
+                                                      policy_digest)
+            self._policy = (load_policy(iter_policy)
+                            if isinstance(iter_policy, str) else iter_policy)
+            self.policy_digest = policy_digest(self._policy)
+        #: early-exit execution mode: per-bucket (tau, budget, min_iters)
+        #: from the policy replace the fixed trip count; the aux gains
+        #: iters_taken. Default None = adaptive iff a policy was given.
+        self.adaptive = (bool(adaptive) if adaptive is not None
+                         else self._policy is not None)
+        if self.adaptive and self._policy is None:
+            raise ValueError("adaptive=True needs an iter_policy (the "
+                             "thresholds/budgets are compiled in from the "
+                             "recorded policy — cli converge --emit-policy)")
+        if self.adaptive and numerics:
+            raise ValueError("numerics taps are not supported on the "
+                             "adaptive path (models/raft_stereo.py); "
+                             "record numerics with adaptive=False")
+        if self.adaptive:
+            converge = True  # the per-sample residual aux is intrinsic
         #: record per-sample convergence curves (iter_metrics="per_sample"
         #: aux — the compiled forward gains one tiny reduction per
         #: iteration); False keeps the exact prior program
@@ -147,6 +174,10 @@ class StereoPredictor:
         if iter_epe:
             self.converge = True
         self._last_aux: Optional[Dict[str, np.ndarray]] = None
+        # whether the LAST _prepared resolved an adaptive policy entry
+        # (an uncovered bucket falls back to the fixed path, so the aux
+        # layout is decided per dispatch, not per predictor)
+        self._adaptive_used = False
         self._compiled: Dict[Tuple, Any] = {}
         # "ring" shards the width axis over every available device (sequence
         # parallelism for very wide pairs). Pad W so each device's 1/factor-
@@ -165,14 +196,36 @@ class StereoPredictor:
                 PAD_DIVIS, cfg.factor * n * 2 ** (cfg.corr_levels - 1))
 
     def _forward(self, shape: Tuple[int, int, int], iters: int,
-                 with_gt: bool = False):
-        key = shape + (iters, self.converge, with_gt, self.numerics)
+                 with_gt: bool = False,
+                 entry: Optional[Tuple[float, int, int]] = None):
+        key = shape + (iters, self.converge, with_gt, self.numerics, entry)
         fn = self._compiled.get(key)
         if fn is None:
             model = self.model
             numerics = self.numerics
 
-            if self.converge and with_gt:
+            if entry is not None:
+                # Early-exit flavor: the policy's (tau, budget, min_iters)
+                # are compile-time constants — a different policy entry is
+                # a different executable (serve/cache.py keys flavors on
+                # the policy digest for the same reason). ``iters`` here
+                # IS the bucket budget (resolved in _prepared).
+                tau, _, min_iters = entry
+
+                if with_gt:
+                    def run(variables, image1, image2, flow_gt, valid):
+                        return model.apply(
+                            variables, image1, image2, iters=iters,
+                            test_mode=True, iter_metrics="per_sample",
+                            flow_gt=flow_gt, loss_mask=valid,
+                            adaptive_tau=tau, adaptive_min_iters=min_iters)
+                else:
+                    def run(variables, image1, image2):
+                        return model.apply(
+                            variables, image1, image2, iters=iters,
+                            test_mode=True, iter_metrics="per_sample",
+                            adaptive_tau=tau, adaptive_min_iters=min_iters)
+            elif self.converge and with_gt:
                 def run(variables, image1, image2, flow_gt, valid):
                     return model.apply(variables, image1, image2,
                                        iters=iters, test_mode=True,
@@ -230,22 +283,51 @@ class StereoPredictor:
             im1, im2 = jax.device_put(im1, spec), jax.device_put(im2, spec)
             if gt_args:
                 gt_args = tuple(jax.device_put(x, spec) for x in gt_args)
+        entry = None
+        if self.adaptive:
+            doc = self.policy_entry(h, w)
+            if doc is not None:
+                # The policy budget replaces the fixed trip count for this
+                # bucket; an explicit smaller per-call ``iters`` still caps
+                # it. Buckets the policy doesn't cover fall back to the
+                # fixed path (no iters_taken aux for those calls).
+                entry = (float(doc["tau"]), int(doc["budget"]),
+                         int(doc["min_iters"]))
+                iters = min(iters, entry[1]) if iters else entry[1]
+        self._adaptive_used = entry is not None
         fn = self._forward(tuple(im1.shape[:3]), iters,
-                           with_gt=bool(gt_args))
+                           with_gt=bool(gt_args), entry=entry)
         return padder, fn, im1, im2, gt_args, ctx
+
+    def policy_entry(self, height: int, width: int) -> Optional[Dict]:
+        """The iteration-policy entry the PADDED ``(height, width)`` bucket
+        resolves to (``{"tau", "budget", "min_iters", ...}``), or None when
+        no policy is loaded / the bucket is uncovered and the policy has no
+        default. Serve uses this to size its per-bucket iteration budget
+        before dispatch (serve/server.py)."""
+        if self._policy is None:
+            return None
+        from raft_stereo_tpu.obs.converge import policy_lookup
+        bucket = "%dx%d" % (bucket_size(height, PAD_DIVIS, self.bucket),
+                            bucket_size(width, self._w_divis, self.bucket))
+        return policy_lookup(self._policy, bucket)
 
     def _aux_of(self, outs) -> Optional[Dict[str, Any]]:
         """Slot the aux outputs after (flow_lr, flow_up) into a dict.
 
         Layout (models/raft_stereo.py): residual, then epe when GT was
-        supplied, then the numerics tap dict LAST. Values stay whatever
-        they are (device arrays here; the fetch points convert)."""
+        supplied, then iters_taken on the adaptive path, then the numerics
+        tap dict LAST (numerics and adaptive are mutually exclusive).
+        Values stay whatever they are (device arrays here; the fetch
+        points convert)."""
         if not (self.converge or self.numerics):
             return None
         rest = list(outs[2:])
         aux: Dict[str, Any] = {}
         if self.numerics:
             aux["numerics"] = rest.pop()
+        if getattr(self, "_adaptive_used", False):
+            aux["iters_taken"] = rest.pop()
         if self.converge:
             aux["residual"] = rest[0]
             if len(rest) > 1:

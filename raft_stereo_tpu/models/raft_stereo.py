@@ -264,7 +264,9 @@ class RAFTStereo(nn.Module):
     def __call__(self, image1, image2, iters: int = 12, flow_init=None,
                  test_mode: bool = False, flow_gt=None, loss_mask=None,
                  stage: str = "full", enc_outs=None,
-                 iter_metrics: bool = False, numerics: bool = False):
+                 iter_metrics: bool = False, numerics: bool = False,
+                 adaptive_tau: Optional[float] = None,
+                 adaptive_min_iters: int = 1):
         """``flow_gt``/``loss_mask`` (both ``(B, H, W, 1)``) switch on the
         fused-loss training path: returns ``(per_iter_err_sums (iters,),
         final flow_up (B, H, W, 1))`` instead of the stacked predictions —
@@ -315,6 +317,22 @@ class RAFTStereo(nn.Module):
         tuple. ``False`` (the default) arms no tap sink, so the traced
         program is byte-identical to the numerics-free one (the
         ``--no_numerics`` pin).
+
+        ``adaptive_tau`` (test mode only; requires
+        ``iter_metrics="per_sample"``): the in-graph early-exit mode —
+        ``iters`` becomes the policy budget and a per-sample convergence
+        mask freezes samples whose applied update moved the disparity
+        field less than ``adaptive_tau`` (mean |Δdisparity|, low-res px,
+        strict ``<``, after at least ``adaptive_min_iters`` applications);
+        frozen carries pass through later iterations unchanged. The return
+        gains ``iters_taken (B,)`` int32 after the residual (and EPE)
+        stacks: ``(flow_lowres, flow_up, delta_norms[, epes],
+        iters_taken)``. ``cfg.adaptive_mode`` selects the mechanism
+        (masked fixed-trip scan vs whole-batch ``lax.while_loop``);
+        ``adaptive_tau=0.0`` never freezes anything, so the flow is
+        bitwise identical to the fixed-trip scan at the same budget.
+        ``adaptive_tau=None`` (the default) leaves the traced program
+        byte-identical to the pre-adaptive one.
         """
         cfg = self.cfg
         dt = self.compute_dtype
@@ -323,7 +341,7 @@ class RAFTStereo(nn.Module):
             cnet_list, fmap1, fmap2 = enc_outs
             return self._refine(cnet_list, fmap1, fmap2, iters, flow_init,
                                 test_mode, flow_gt, loss_mask, iter_metrics,
-                                numerics)
+                                numerics, adaptive_tau, adaptive_min_iters)
 
         image1 = (2.0 * (image1 / 255.0) - 1.0).astype(jnp.float32)
         image2 = (2.0 * (image2 / 255.0) - 1.0).astype(jnp.float32)
@@ -415,10 +433,11 @@ class RAFTStereo(nn.Module):
             return tuple(cnet_list), fmap1, fmap2
         return self._refine(tuple(cnet_list), fmap1, fmap2, iters, flow_init,
                             test_mode, flow_gt, loss_mask, iter_metrics,
-                            numerics)
+                            numerics, adaptive_tau, adaptive_min_iters)
 
     def _refine(self, cnet_list, fmap1, fmap2, iters, flow_init, test_mode,
-                flow_gt, loss_mask, iter_metrics=False, numerics=False):
+                flow_gt, loss_mask, iter_metrics=False, numerics=False,
+                adaptive_tau=None, adaptive_min_iters=1):
         """Post-encoder forward: context processing, correlation pyramid, the
         refinement scan, and the upsample/loss tail. Called from the compact
         ``__call__`` (both the monolithic and staged paths)."""
@@ -439,6 +458,22 @@ class RAFTStereo(nn.Module):
                              "(inference) scan only; the training side is "
                              "the per-leaf gradient-norm vector "
                              "(training/state.py numerics=True)")
+        if adaptive_tau is not None:
+            if not test_mode:
+                raise ValueError("adaptive early exit (adaptive_tau) exists "
+                                 "on the test_mode (inference) path only")
+            if iter_metrics != "per_sample":
+                raise ValueError("adaptive early exit requires "
+                                 "iter_metrics='per_sample' — the "
+                                 "per-sample residual both drives the "
+                                 "freeze mask and rides the aux")
+            if numerics:
+                raise ValueError("numerics taps are not supported on the "
+                                 "adaptive path; record numerics on the "
+                                 "fixed-trip scan")
+            if adaptive_tau < 0:
+                raise ValueError(f"adaptive_tau must be >= 0, got "
+                                 f"{adaptive_tau}")
         cfg = self.cfg
         dt = self.compute_dtype
 
@@ -495,6 +530,15 @@ class RAFTStereo(nn.Module):
             # reference's own warm starts carry y = 0 by construction).
             flow_init = flow_init.at[..., 1].set(0.0)
             coords1 = coords1 + flow_init
+
+        if test_mode and adaptive_tau is not None:
+            # The early-exit mode is a SEPARATE branch: the default path
+            # below stays byte-identical when adaptive_tau is None (the
+            # adaptive=False pin, tests/test_adaptive.py).
+            return self._refine_adaptive(
+                net_list, inp_list, corr_state, coords0, coords1, iters,
+                adaptive_tau, adaptive_min_iters, flow_gt,
+                loss_mask, use_fused_lookup, dt)
 
         fused = flow_gt is not None and not test_mode
         if fused and loss_mask is None:
@@ -817,6 +861,171 @@ class RAFTStereo(nn.Module):
         if fused:
             return flow_predictions, carry[2]
         return flow_predictions
+
+    def _refine_adaptive(self, net_list, inp_list, corr_state, coords0,
+                         coords1, iters, tau, min_iters, flow_gt, loss_mask,
+                         use_fused_lookup, dt):
+        """Early-exit test-mode refinement (the ROADMAP 1(b) actuation half).
+
+        Same per-iteration body as the fixed-trip test-mode scan in
+        :meth:`_refine`; a per-sample convergence mask rides the carry.
+        Once an APPLIED update moved a sample's disparity field less than
+        ``tau`` (mean |Δdisparity| in low-res px, strict ``<``, after at
+        least ``min_iters`` applications) the sample freezes: every later
+        iteration computes the body but ``jnp.where``-discards it, so the
+        carry passes through unchanged and the residual row records 0.0.
+        ``iters`` is the policy budget (the trip count); ``iters_taken``
+        counts applied updates per sample (final mask iteration included).
+
+        ``cfg.adaptive_mode`` selects the mechanism: ``"masked_scan"``
+        keeps the fixed-length ``nn.scan`` (static trip count — the
+        AOT/serve flavor), ``"while_loop"`` wraps the same masked body in
+        a ``lax.while_loop`` that exits as soon as every sample froze
+        (residual/EPE rows after a whole-batch exit stay 0.0). Both end
+        with the same unscanned mask-head iteration, which always runs:
+        the convex-upsample mask must exist even for frozen samples, and
+        its update applies only to still-active ones. ``tau=0.0`` never
+        freezes anything (residuals are non-negative), so the flow is
+        bitwise identical to the fixed-trip scan at the same budget.
+        """
+        cfg = self.cfg
+        b = net_list[0].shape[0]
+        budget = iters          # static python trip count (the fixed one)
+        tau = jnp.float32(tau)
+        refine = RefinementStep(cfg, True, False, False, dt,
+                                fused_lookup=use_fused_lookup,
+                                name="refinement")
+
+        def _res_ps(c_new, c_old):
+            return jnp.mean(jnp.abs((c_new[1] - c_old[1])[..., 0]),
+                            axis=(1, 2))
+
+        # Per-sample low-res EPE proxy, pooled once — same math as the
+        # fixed path's iter_epe closure (per_sample variant).
+        iter_epe = None
+        if flow_gt is not None:
+            f = cfg.factor
+            h, w = net_list[0].shape[1:3]
+            gt = flow_gt.astype(jnp.float32)[..., 0]
+            m = (jnp.ones_like(gt) if loss_mask is None
+                 else loss_mask.astype(jnp.float32)[..., 0])
+            gt_c = gt.reshape(b, h, f, w, f)
+            m_c = m.reshape(b, h, f, w, f)
+            msum = m_c.sum(axis=(2, 4))
+            gt_pool = (gt_c * m_c).sum(axis=(2, 4)) / jnp.maximum(msum, 1.0)
+            cell_valid = (msum > 0).astype(jnp.float32)
+            denom = jnp.maximum(cell_valid.sum(axis=(1, 2)), 1.0)
+
+            def iter_epe(c):
+                err = jnp.abs((c[1] - coords0)[..., 0] * f - gt_pool)
+                return jnp.sum(err * cell_valid, axis=(1, 2)) / denom
+
+        def _advance(cur, act, taken, c2):
+            """Apply one computed step under the freeze mask: returns the
+            masked carry, next-iteration mask, applied-step counts, and
+            this iteration's residual row (0.0 where frozen — the applied
+            delta there is zero, whatever the discarded body computed)."""
+            r = _res_ps(c2, cur)
+            mask = act[:, None, None, None]
+            nxt = (tuple(jnp.where(mask, n2, n1)
+                         for n1, n2 in zip(cur[0], c2[0])),
+                   jnp.where(mask, c2[1], cur[1]))
+            row = jnp.where(act, r, 0.0)
+            taken = taken + act.astype(jnp.int32)
+            act = act & ((r >= tau) | (taken < min_iters))
+            return nxt, act, taken, row
+
+        active = jnp.ones((b,), jnp.bool_)
+        taken = jnp.zeros((b,), jnp.int32)
+        cur = (tuple(net_list), coords1)
+        res_rows = None
+        epe_rows = None
+
+        if (cfg.adaptive_mode == "while_loop" and budget > 1
+                and not self.is_initializing()):
+            # Whole-batch dynamic trip count: the cond exits the loop the
+            # moment every sample froze (or the budget ran out). The body
+            # is applied functionally on the scope's refinement params
+            # (the batched_scan_wgrad precedent) — flax modules cannot be
+            # called under lax.while_loop directly.
+            params_ref = self.scope.get_variable("params", "refinement")
+            if params_ref is None:
+                raise ValueError(
+                    "adaptive_mode='while_loop' needs initialized "
+                    "'refinement' params (init the model before apply)")
+            pure = RefinementStep(cfg, True, False, False, dt,
+                                  fused_lookup=use_fused_lookup,
+                                  parent=None)
+            rbuf = jnp.zeros((budget - 1, b), jnp.float32)
+            ebuf = (jnp.zeros((budget - 1, b), jnp.float32)
+                    if iter_epe is not None else None)
+
+            def cond(st):
+                return jnp.logical_and(st[0] < budget - 1, jnp.any(st[3]))
+
+            def body(st):
+                if iter_epe is not None:
+                    step, net, coords, act, tk, rb, eb = st
+                else:
+                    step, net, coords, act, tk, rb = st
+                c = (net, coords)
+                c2, _unused = pure.apply(
+                    {"params": params_ref}, c, corr_state, tuple(inp_list),
+                    coords0, None, compute_mask=False)
+                nxt, act, tk, row = _advance(c, act, tk, c2)
+                rb = jax.lax.dynamic_update_index_in_dim(rb, row, step, 0)
+                if iter_epe is not None:
+                    eb = jax.lax.dynamic_update_index_in_dim(
+                        eb, iter_epe(nxt), step, 0)
+                    return (step + 1, nxt[0], nxt[1], act, tk, rb, eb)
+                return (step + 1, nxt[0], nxt[1], act, tk, rb)
+
+            init = (jnp.int32(0), cur[0], cur[1], active, taken, rbuf)
+            if iter_epe is not None:
+                init = init + (ebuf,)
+            out = jax.lax.while_loop(cond, body, init)
+            cur, active, taken = (out[1], out[2]), out[3], out[4]
+            res_rows = out[5]
+            if iter_epe is not None:
+                epe_rows = out[6]
+        elif budget > 1:
+            def scan_iter(mdl, c, _):
+                cur, act, tk = (c[0], c[1]), c[2], c[3]
+                c2, _unused = mdl(cur, corr_state, tuple(inp_list),
+                                  coords0, None, compute_mask=False)
+                nxt, act, tk, row = _advance(cur, act, tk, c2)
+                y = (row,) if iter_epe is None else (row, iter_epe(nxt))
+                return (nxt[0], nxt[1], act, tk), y
+
+            carry4, ys = nn.scan(
+                scan_iter,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                length=budget - 1,
+                unroll=cfg.scan_unroll,
+            )(refine, (cur[0], cur[1], active, taken), None)
+            cur, active, taken = (carry4[0], carry4[1]), carry4[2], carry4[3]
+            res_rows = ys[0]
+            if iter_epe is not None:
+                epe_rows = ys[1]
+
+        # Final iteration always runs unscanned with the mask head on (the
+        # convex-upsample mask must exist even when every sample froze);
+        # its carry update still respects the freeze mask.
+        c2, up_mask = refine(cur, corr_state, tuple(inp_list), coords0, None)
+        nxt, active, taken, row = _advance(cur, active, taken, c2)
+        coords1 = nxt[1]
+        flow_up = upsample_disparity_convex(coords1 - coords0, up_mask,
+                                            cfg.factor)
+        delta_norms = (row[None] if res_rows is None
+                       else jnp.concatenate([res_rows, row[None]]))
+        ret = (coords1 - coords0, flow_up, delta_norms)
+        if iter_epe is not None:
+            final_epe = iter_epe(nxt)[None]
+            epes = (final_epe if epe_rows is None
+                    else jnp.concatenate([epe_rows, final_epe]))
+            ret = ret + (epes,)
+        return ret + (taken,)
 
 
 def create_model(cfg: RAFTStereoConfig, dtype: Optional[Dtype] = None) -> RAFTStereo:

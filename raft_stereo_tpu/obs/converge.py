@@ -20,6 +20,16 @@ recording and decision layer on top of those aux outputs:
 * :func:`main` — ``cli converge <run_dir>`` over a recorded run.
 * :func:`exit_percentile` — "by which iteration had p95 converged?"; the
   evidence behind the doctor's OVER_ITERATED verdict (obs/doctor.py).
+* :func:`build_policy` / :func:`load_policy` / :func:`policy_digest` /
+  :func:`policy_lookup` — the actuation half (r16): ``cli converge
+  <run_dir> --emit-policy iter_policy.json`` distills the decision table
+  into a checked-in per-bucket iteration policy (τ, budget, min_iters,
+  provenance: source run + the table row that earned each entry) that the
+  adaptive inference mode compiles in (models/raft_stereo.py
+  ``adaptive_tau``; threaded by inference.StereoPredictor, eval
+  ``--iter_policy`` and the serve adaptive cache flavors keyed on
+  :func:`policy_digest`). Schema lint: obs/validate.py
+  ``check_iter_policy``.
 
 The curves are disparity-residual curves in low-res pixels: τ is "the
 mean |Δdisparity| one more iteration would still apply". The serial-floor
@@ -226,6 +236,98 @@ def decision_table(records: Iterable[Dict[str, Any]],
     return rows
 
 
+# --- the recorded iteration policy (the actuation half) ---------------------
+
+#: current iter_policy.json schema version
+POLICY_VERSION = 1
+#: top-level marker that routes a JSON artifact to the policy lint
+POLICY_KIND = "iter_policy"
+
+
+def build_policy(records: Iterable[Dict[str, Any]], *,
+                 tau: float = DOCTOR_TAU, min_iters: int = 1,
+                 margin: int = 1, source_run: str = "?") -> Dict[str, Any]:
+    """Distill recorded curves into a per-bucket iteration policy.
+
+    One entry per shape bucket (plus a ``default`` from the collapsed
+    ``"*"`` rows): exit threshold ``tau``, iteration ``budget`` =
+    ``exit_p95 + margin`` clamped to the recorded budget (the p95 exit
+    plus safety margin — the policy must not cost quality the table never
+    predicted), and ``min_iters``. Every entry carries provenance — the
+    source run and the decision-table row that earned it — so the lint
+    (obs/validate.py check_iter_policy) can hold the numbers referentially
+    against their origin. When several sources share a bucket the LARGEST
+    candidate budget wins (the conservative merge).
+    """
+    recs = list(records)
+    if not recs:
+        raise ValueError("no converge records to build a policy from")
+    rows = decision_table(recs, taus=(float(tau),), bucket_by="both")
+
+    def entry_of(row: Dict[str, Any]) -> Dict[str, Any]:
+        budget = min(int(row["budget"]), int(row["exit_p95"]) + int(margin))
+        budget = max(1, budget)
+        return {
+            "tau": float(row["tau"]),
+            "budget": budget,
+            "min_iters": max(1, min(int(min_iters), budget)),
+            "provenance": {"source": row["source"], "row": dict(row)},
+        }
+
+    buckets: Dict[str, Dict[str, Any]] = {}
+    default: Optional[Dict[str, Any]] = None
+    for row in rows:
+        e = entry_of(row)
+        if row["bucket"] == "*":
+            if default is None or e["budget"] > default["budget"]:
+                default = e
+        elif row["bucket"] != "?":
+            cur = buckets.get(row["bucket"])
+            if cur is None or e["budget"] > cur["budget"]:
+                buckets[row["bucket"]] = e
+    doc: Dict[str, Any] = {
+        "kind": POLICY_KIND, "version": POLICY_VERSION,
+        "source_run": source_run, "buckets": buckets,
+    }
+    if default is not None:
+        doc["default"] = default
+    return doc
+
+
+def policy_digest(doc: Dict[str, Any]) -> str:
+    """Short stable digest of a policy doc — the serve cache-flavor key
+    (serve/cache.py) and the provenance stamp on emitted events."""
+    import hashlib
+    blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def load_policy(path: str) -> Dict[str, Any]:
+    """Load + lint one ``iter_policy.json``; raises ValueError with the
+    first named violation — a doctored policy must fail at load, not at
+    serve time."""
+    with open(path) as f:
+        doc = json.load(f)
+    from raft_stereo_tpu.obs.validate import check_iter_policy
+    errors = check_iter_policy(doc)
+    if errors:
+        raise ValueError(f"{path}: {errors[0]}"
+                         + (f" (+{len(errors) - 1} more)"
+                            if len(errors) > 1 else ""))
+    return doc
+
+
+def policy_lookup(doc: Dict[str, Any],
+                  bucket: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Resolve one bucket (``"HxW"``) to its policy entry; falls back to
+    the ``default`` entry, then None (caller keeps the fixed trip)."""
+    if bucket is not None:
+        e = doc.get("buckets", {}).get(bucket)
+        if e is not None:
+            return e
+    return doc.get("default")
+
+
 def format_table(rows: List[Dict[str, Any]]) -> str:
     """Render the decision table for the terminal."""
     header = (f"{'source':<18} {'bucket':<12} {'tau':>6} {'n':>5} "
@@ -258,6 +360,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     doc = {"run_dir": args.run_dir, "curves": len(records),
            "taus": list(taus), "bucket_by": args.bucket_by,
            "table": rows}
+    if args.emit_policy:
+        ptau = DOCTOR_TAU if args.policy_tau is None else args.policy_tau
+        policy = build_policy(records, tau=ptau,
+                              min_iters=args.policy_min_iters,
+                              margin=args.policy_margin,
+                              source_run=args.run_dir)
+        os.makedirs(os.path.dirname(args.emit_policy) or ".", exist_ok=True)
+        with open(args.emit_policy, "w") as f:
+            json.dump(policy, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"iter policy written: {args.emit_policy} "
+              f"({len(policy['buckets'])} bucket(s)"
+              f"{', default' if 'default' in policy else ''}, "
+              f"tau={ptau:g}, digest {policy_digest(policy)})",
+              file=sys.stderr)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:

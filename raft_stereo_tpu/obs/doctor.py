@@ -232,9 +232,10 @@ def _diagnose_converge(records) -> Optional[Dict[str, Any]]:
         f"{ev['n_converged']}/{ev['n']} converged within budget)",
         f"the last {budget - p95} iterations refine disparities that "
         f"have stopped moving — device time with no quality return",
-        "replay exit thresholds against these curves with "
-        "`cli converge <run_dir>` (no model re-run) before lowering "
-        "the budget",
+        "freeze the operating point into a policy with `cli converge "
+        "<run_dir> --emit-policy iter_policy.json` and serve it via "
+        "--iter_policy — the compiled early exit banks these savings "
+        "per sample instead of lowering the budget for everyone",
     ])
 
 

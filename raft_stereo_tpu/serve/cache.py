@@ -48,10 +48,16 @@ class BucketKey(NamedTuple):
     batch: int
     iters: int
     warm: bool    # True = the flavor with a flow_init input
+    #: iteration-policy digest (obs/converge.py policy_digest) for the
+    #: compiled early-exit flavor — "" is the fixed-trip program. Part of
+    #: the key so a policy swap can never silently reuse executables
+    #: compiled against different (tau, budget, min_iters) constants.
+    policy: str = ""
 
     def label(self) -> str:
         return (f"{self.height}x{self.width}b{self.batch}i{self.iters}"
-                f"{'w' if self.warm else ''}")
+                f"{'w' if self.warm else ''}"
+                f"{'@' + self.policy if self.policy else ''}")
 
 
 class ExecutableCache:
@@ -65,11 +71,38 @@ class ExecutableCache:
 
     def __init__(self, cfg: RAFTStereoConfig, variables: Dict, *,
                  telemetry=None, aot: bool = True, converge: bool = False,
-                 numerics: bool = False):
+                 numerics: bool = False, iter_policy=None,
+                 adaptive: Optional[bool] = None):
         self.cfg = cfg
         self.model = create_model(cfg)
         self.telemetry = telemetry
         self.aot = aot
+        #: recorded iteration policy (obs/converge.py iter_policy.json,
+        #: path or pre-loaded doc) backing the adaptive program flavors;
+        #: loading lints it, so a doctored policy fails server construction
+        self.policy = None
+        self.policy_digest: str = ""
+        if iter_policy is not None:
+            from raft_stereo_tpu.obs.converge import (load_policy,
+                                                      policy_digest)
+            self.policy = (load_policy(iter_policy)
+                           if isinstance(iter_policy, str) else iter_policy)
+            self.policy_digest = policy_digest(self.policy)
+        #: serve the compiled early-exit flavors for buckets the policy
+        #: covers (fixed-trip programs everywhere else). Default: adaptive
+        #: iff a policy was given; adaptive=False with a policy loaded
+        #: ignores it (the pre-adaptive bitwise pin).
+        self.adaptive = (bool(adaptive) if adaptive is not None
+                         else self.policy is not None)
+        if self.adaptive and self.policy is None:
+            raise ValueError("adaptive serving needs an iter_policy "
+                             "(cli converge --emit-policy)")
+        if self.adaptive and numerics:
+            raise ValueError("the adaptive program flavors carry no "
+                             "numerics taps (models/raft_stereo.py); "
+                             "serve --numerics needs --adaptive off")
+        if self.adaptive:
+            converge = True  # the per-sample residual aux is intrinsic
         #: serve the converge flavor: the program additionally returns the
         #: per-sample per-iteration |Δdisparity| curves (``(iters, B)``,
         #: iter_metrics="per_sample") feeding the convergence observatory
@@ -114,24 +147,53 @@ class ExecutableCache:
 
     # --- compilation ---------------------------------------------------------
 
+    def bucket_entry(self, height: int, width: int) -> Optional[Dict]:
+        """The policy entry for a PADDED bucket shape (``{"tau", "budget",
+        "min_iters", ...}``), or None when adaptive is off / the bucket is
+        uncovered. The scheduler resolves this per group to pick the
+        iteration budget and the key's policy digest."""
+        if not self.adaptive:
+            return None
+        from raft_stereo_tpu.obs.converge import policy_lookup
+        return policy_lookup(self.policy, f"{height}x{width}")
+
     def _build(self, key: BucketKey):
         model, iters = self.model, key.iters
         converge = self.converge
         numerics = self.numerics
+        entry = self.bucket_entry(key.height, key.width) if key.policy \
+            else None
+        if key.policy and entry is None:
+            raise ValueError(
+                f"bucket key {key.label()} names policy {key.policy} but "
+                f"the loaded policy (digest {self.policy_digest}) does not "
+                f"cover {key.height}x{key.width}")
 
         def forward(variables, im1, im2, flow_init=None):
-            """(flow_lr, flow_up, finite[, deltas][, taps]) — the converge
-            flavor appends the per-sample convergence curves, the numerics
-            flavor the per-iteration tap-statistics dict (always LAST)."""
+            """(flow_lr, flow_up, finite[, deltas][, iters_taken][, taps])
+            — the converge flavor appends the per-sample convergence
+            curves, the adaptive flavor additionally the per-sample
+            iterations applied, the numerics flavor the per-iteration
+            tap-statistics dict (always LAST; never combined with
+            adaptive)."""
             metrics = "per_sample" if converge else False
-            out = model.apply(variables, im1, im2, iters=iters,
-                              flow_init=flow_init, test_mode=True,
-                              iter_metrics=metrics, numerics=numerics)
+            if entry is not None:
+                out = model.apply(variables, im1, im2, iters=iters,
+                                  flow_init=flow_init, test_mode=True,
+                                  iter_metrics=metrics,
+                                  adaptive_tau=float(entry["tau"]),
+                                  adaptive_min_iters=int(entry["min_iters"]))
+            else:
+                out = model.apply(variables, im1, im2, iters=iters,
+                                  flow_init=flow_init, test_mode=True,
+                                  iter_metrics=metrics, numerics=numerics)
             flow_lr, flow_up = out[0], out[1]
             finite = jnp.all(jnp.isfinite(flow_up), axis=(1, 2, 3))
             ret = (flow_lr, flow_up, finite)
             if converge:
                 ret = ret + (out[2],)
+            if entry is not None:
+                ret = ret + (out[-1],)  # iters_taken (B,)
             if numerics:
                 ret = ret + (out[-1],)
             return ret

@@ -94,6 +94,24 @@ _PROM_QUALITY = (
 )
 
 
+# per-bucket adaptive iteration gauges (stats()["iters"], present when
+# requests ride the compiled early-exit flavors, ``cli serve
+# --iter_policy``): rolling iters_taken percentiles per shape bucket —
+# the scrapeable evidence that the recorded policy is actually saving
+# iterations in production (and, against raft_serve_final_residual_*,
+# that quality holds).
+_PROM_ITERS = (
+    ("iters_taken_p50", "raft_serve_iters_taken_p50",
+     "Rolling p50 of refinement iterations applied per request"),
+    ("iters_taken_p95", "raft_serve_iters_taken_p95",
+     "Rolling p95 of refinement iterations applied per request"),
+    ("iters_taken_mean", "raft_serve_iters_taken_mean",
+     "Rolling mean of refinement iterations applied per request"),
+    ("n", "raft_serve_iters_window_requests",
+     "Requests inside the rolling iters_taken window"),
+)
+
+
 # per-bucket output-range drift gauges (stats()["output_range"], present
 # when the server runs the numerics flavor, ``cli serve --numerics``):
 # rolling extremes of the served flow per shape bucket — the scrapeable
@@ -127,6 +145,17 @@ def prometheus_metrics(stats: dict) -> str:
             lines.append(f"# TYPE {name} gauge")
             for bucket in sorted(quality):
                 value = quality[bucket].get(key)
+                if value is None:
+                    continue
+                lines.append(f'{name}{{bucket="{bucket}"}} '
+                             f"{float(value):g}")
+    iters = stats.get("iters") or {}
+    if iters:
+        for key, name, help_text in _PROM_ITERS:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            for bucket in sorted(iters):
+                value = iters[bucket].get(key)
                 if value is None:
                     continue
                 lines.append(f'{name}{{bucket="{bucket}"}} '

@@ -113,6 +113,17 @@ class ServeConfig:
     #: observability only when asked, and the default program stays
     #: byte-identical to the numerics-free one.
     numerics: bool = False
+    #: iteration-policy JSON path (or pre-loaded doc) from `cli converge
+    #: --emit-policy`: buckets the policy covers are served by the
+    #: compiled early-exit flavors — the bucket's recorded (tau, budget,
+    #: min_iters) replace default_iters, per-request ``iters_taken`` rides
+    #: the request/slo telemetry. Uncovered buckets keep the fixed
+    #: programs.
+    iter_policy: Any = None
+    #: early-exit execution mode override; None = adaptive iff iter_policy
+    #: is set, False ignores a loaded policy (the pre-adaptive bitwise
+    #: pin), True without a policy is an error.
+    adaptive: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -134,6 +145,9 @@ class ServeResult:
     bucket: str = ""
     #: last-iteration mean |Δdisparity| (converge aux; None when off)
     final_residual: Optional[float] = None
+    #: refinement iterations actually applied to this request by the
+    #: compiled early-exit flavor (None on fixed-trip programs)
+    iters_taken: Optional[int] = None
     #: host-side min/max of the unpadded output flow (numerics flavor's
     #: output-range drift gauges; None on errors or with numerics off)
     output_min: Optional[float] = None
@@ -199,7 +213,9 @@ class StereoServer:
         self.cache = ExecutableCache(cfg, variables, telemetry=telemetry,
                                      aot=self.serve.aot,
                                      converge=self.serve.converge,
-                                     numerics=self.serve.numerics)
+                                     numerics=self.serve.numerics,
+                                     iter_policy=self.serve.iter_policy,
+                                     adaptive=self.serve.adaptive)
         self.slo = SLOTracker(telemetry, window=self.serve.slo_window,
                               emit_every=self.serve.slo_every)
         self._queue: BoundedQueue = BoundedQueue(self.serve.queue_depth)
@@ -345,10 +361,10 @@ class StereoServer:
         keys = []
         for h, w in shapes:
             bh, bw = self._bucket_shape(h, w)
+            it, policy = self._bucket_plan(
+                bh, bw, int(iters or self.serve.default_iters))
             for b in batch_sizes:
-                keys.append(BucketKey(bh, bw, int(b),
-                                      int(iters or self.serve.default_iters),
-                                      warm))
+                keys.append(BucketKey(bh, bw, int(b), it, warm, policy))
         return self.cache.warmup(keys)
 
     # --- scheduler internals -------------------------------------------------
@@ -357,9 +373,20 @@ class StereoServer:
         return (bucket_size(h, PAD_DIVIS, self.serve.bucket),
                 bucket_size(w, PAD_DIVIS, self.serve.bucket))
 
+    def _bucket_plan(self, bh: int, bw: int, iters: int) -> Tuple[int, str]:
+        """(effective iters, policy digest) for a padded bucket: where the
+        loaded policy covers the bucket, its recorded budget caps the trip
+        count and the group rides the compiled early-exit flavor."""
+        lookup = getattr(self.cache, "bucket_entry", None)
+        entry = lookup(bh, bw) if lookup is not None else None
+        if entry is None:
+            return iters, ""
+        return min(int(iters), int(entry["budget"])), self.cache.policy_digest
+
     def _group_key(self, req: _Request) -> Tuple:
         bh, bw = self._bucket_shape(*req.image1.shape[:2])
-        return (bh, bw, req.iters, req.warm)
+        iters, policy = self._bucket_plan(bh, bw, req.iters)
+        return (bh, bw, iters, req.warm, policy)
 
     def _collect(self, first: _Request) -> List[_Request]:
         first.t_collect = first.t_collect or time.perf_counter()
@@ -397,8 +424,8 @@ class StereoServer:
         return np.zeros(shape, np.float32)
 
     def _dispatch(self, group: List[_Request]) -> None:
-        bh, bw, iters, warm = self._group_key(group[0])
-        key = BucketKey(bh, bw, len(group), iters, warm)
+        bh, bw, iters, warm, policy = self._group_key(group[0])
+        key = BucketKey(bh, bw, len(group), iters, warm, policy)
         padders = []
         im1, im2, inits = [], [], []
         t0 = time.perf_counter()
@@ -435,12 +462,17 @@ class StereoServer:
             flow_up = np.asarray(flow_up)
             finite = np.asarray(finite)
             # aux slots, in program-output order: converge's (iters, B)
-            # per-sample curves first, the numerics tap-stats dict LAST
+            # per-sample curves first, the adaptive flavor's (B,)
+            # iters_taken after them, the numerics tap-stats dict LAST
+            # (adaptive and numerics never combine — cache ctor guard)
             deltas = None
             taps = None
+            taken = None
             if aux and self.serve.numerics:
                 taps = {k: np.asarray(v) for k, v in aux.pop().items()}
-            if aux and self.serve.converge:
+            if aux and key.policy:
+                taken = np.asarray(aux.pop())
+            if aux and getattr(self.cache, "converge", self.serve.converge):
                 deltas = np.asarray(aux[0])
         except Exception as exc:  # device-side execution error
             self._fail_group(group, key, exc, kind="dispatch")
@@ -477,18 +509,27 @@ class StereoServer:
                 self._sessions[req.stream] = (flow_lr[j].shape,
                                               flow_lr[j])
             final_residual = None
+            iters_taken = None if taken is None else int(taken[j])
             if deltas is not None:
-                final_residual = float(deltas[-1, j])
+                extra = {} if iters_taken is None else \
+                    {"iters_taken": iters_taken}
+                # adaptive programs record 0.0 rows for frozen iterations;
+                # the quality gauge wants the residual of the LAST APPLIED
+                # update, not the padding
+                col = deltas[:, j]
+                applied = col[col > 0.0]
+                final_residual = float(applied[-1]) if iters_taken is not \
+                    None and applied.size else float(col[-1])
                 converge_emit(self.telemetry, f"serve:{key.label()}",
                               deltas.shape[0], deltas[:, j],
                               bucket=f"{key.height}x{key.width}",
-                              id=req.id)
+                              id=req.id, **extra)
             self._finish(req, ServeResult(
                 request_id=req.id, ok=True, flow=flow, stream=req.stream,
                 latency_s=now - req.t_submit,
                 queue_wait_s=req.t_dispatch - req.t_submit,
                 batch_size=len(group), bucket=key.label(),
-                final_residual=final_residual,
+                final_residual=final_residual, iters_taken=iters_taken,
                 output_min=output_min, output_max=output_max))
 
     def _fail_group(self, group: List[_Request], key: BucketKey,
@@ -517,6 +558,7 @@ class StereoServer:
             in_flight=len(self._in_flight), stream=req.stream,
             error=result.error, traceback_tail=result.traceback,
             final_residual=result.final_residual,
+            iters_taken=result.iters_taken,
             output_min=result.output_min, output_max=result.output_max)
         # the request's span tree, from the lifecycle stamps already taken:
         # queue_wait / collect_group / dispatch / retire tile the root

@@ -20,7 +20,12 @@ Three records ride the existing event bus (obs/telemetry.py):
   ``output_range`` extra rides the same rollup when the numerics flavor
   is on: per-bucket rolling output-min p05 / output-max p95 of the served
   flow — the drift gauge that catches a model starting to rail its
-  outputs before clients do.
+  outputs before clients do. When requests ride the compiled early-exit
+  flavors (``cli serve --iter_policy``), the ``request`` event carries
+  ``iters_taken`` and the rollup an ``iters`` extra — per-bucket rolling
+  iters_taken p50/p95/mean — so the deployment can see the policy's
+  iteration savings (and, against the ``quality`` gauges, that they cost
+  no quality) without replaying curves.
 
 The tracker is lock-guarded (scheduler thread retires, client threads
 admit) and, like every telemetry path in this repo, fail-open: with
@@ -61,6 +66,10 @@ class SLOTracker:
         # rolling (output_min, output_max) window per bucket label — the
         # output-range drift gauges; fed only when the numerics aux is on
         self._ranges: Dict[str, "deque"] = {}
+        # rolling iters_taken window per bucket label — the adaptive
+        # (early-exit) iteration gauges; fed only when requests ride the
+        # compiled early-exit flavors (serve --iter_policy)
+        self._iters: Dict[str, "deque"] = {}
         self.admitted = 0
         self.completed = 0
         self.failed = 0
@@ -91,15 +100,19 @@ class SLOTracker:
                error: Optional[str] = None,
                traceback_tail: Optional[str] = None,
                final_residual: Optional[float] = None,
+               iters_taken: Optional[int] = None,
                output_min: Optional[float] = None,
                output_max: Optional[float] = None) -> None:
         """Record one terminal request outcome; emits the ``request`` event
         and, on cadence, the ``slo`` rollup. ``final_residual`` (mean
         |Δdisparity| of the last refinement iteration, from the converge
-        aux) feeds the per-bucket rolling quality gauges; ``output_min``/
-        ``output_max`` (host range of the request's unpadded flow, from
-        the numerics flavor) feed the per-bucket output-range drift
-        gauges."""
+        aux) feeds the per-bucket rolling quality gauges;
+        ``iters_taken`` (refinement iterations the compiled early-exit
+        flavor actually applied) feeds the per-bucket adaptive iteration
+        gauges — together they close the policy loop: iterations saved AND
+        quality held; ``output_min``/``output_max`` (host range of the
+        request's unpadded flow, from the numerics flavor) feed the
+        per-bucket output-range drift gauges."""
         now = time.monotonic()
         with self._lock:
             if status == "ok":
@@ -112,6 +125,11 @@ class SLOTracker:
                 if dq is None:
                     dq = self._quality[bucket] = deque(maxlen=self.window)
                 dq.append(float(final_residual))
+            if iters_taken is not None and status == "ok":
+                iq = self._iters.get(bucket)
+                if iq is None:
+                    iq = self._iters[bucket] = deque(maxlen=self.window)
+                iq.append(int(iters_taken))
             if (output_min is not None and output_max is not None
                     and status == "ok"):
                 rq = self._ranges.get(bucket)
@@ -137,6 +155,8 @@ class SLOTracker:
                 payload["traceback"] = traceback_tail[-2000:]
             if final_residual is not None:
                 payload["final_residual"] = round(float(final_residual), 6)
+            if iters_taken is not None:
+                payload["iters_taken"] = int(iters_taken)
             if output_min is not None:
                 payload["output_min"] = round(float(output_min), 4)
             if output_max is not None:
@@ -175,6 +195,18 @@ class SLOTracker:
                     "n": len(dq),
                 }
                 for bucket, dq in sorted(self._quality.items()) if dq
+            }
+        if self._iters:
+            snap["iters"] = {
+                bucket: {
+                    "iters_taken_p50": round(
+                        percentile(sorted(iq), 50), 2),
+                    "iters_taken_p95": round(
+                        percentile(sorted(iq), 95), 2),
+                    "iters_taken_mean": round(sum(iq) / len(iq), 3),
+                    "n": len(iq),
+                }
+                for bucket, iq in sorted(self._iters.items()) if iq
             }
         if self._ranges:
             snap["output_range"] = {

@@ -37,6 +37,12 @@ def main():
                         help="refinement-scan unroll factor (training A/B'd "
                              "at b8 where it lost; inference at batch 1 is "
                              "dispatch-heavier, hence the separate knob)")
+    parser.add_argument("--iter_policy", metavar="PATH", default=None,
+                        help="recorded iteration policy (cli converge "
+                             "--emit-policy): adds an adaptive end-to-end "
+                             "row running the compiled early-exit flavor, "
+                             "reporting mean iters_taken and the wall-clock "
+                             "delta vs the fixed-trip row")
     args = parser.parse_args()
 
     import jax
@@ -131,6 +137,36 @@ def main():
               f"end-to-end {e2e*1e3:7.1f} ms/frame = {1/e2e:6.2f} FPS | "
               f"pipelined(K={window}) {pipe*1e3:7.1f} ms/frame = "
               f"{1/pipe:6.2f} FPS (platform {platform})")
+
+        # --- adaptive end-to-end: the same numpy-in/numpy-out path on the
+        # compiled early-exit flavor. The policy's budget replaces the
+        # fixed trip count and each frame reports the iterations actually
+        # applied — the honest iters-saved + wall-clock evidence next to
+        # the fixed row above.
+        if args.iter_policy:
+            pred_a = StereoPredictor(cfg, variables, valid_iters=iters,
+                                     iter_policy=args.iter_policy)
+            entry = pred_a.policy_entry(h, w)
+            pred_a(left, right)  # compile + warmup
+            pred_a(left, right)
+            pred_a.take_aux()
+            taken = []
+            t0 = time.perf_counter()
+            for _ in range(n):
+                pred_a(left, right)
+                aux = pred_a.take_aux() or {}
+                if aux.get("iters_taken") is not None:
+                    taken.extend(int(x) for x in
+                                 np.ravel(aux["iters_taken"]))
+            ada = (time.perf_counter() - t0) / n
+            budget = entry["budget"] if entry else iters
+            mean_taken = sum(taken) / len(taken) if taken else float(iters)
+            cov = "covered" if entry is not None else "UNCOVERED -> fixed"
+            print(f"{name:9s} adaptive  {h}x{w}: "
+                  f"end-to-end {ada*1e3:7.1f} ms/frame = {1/ada:6.2f} FPS "
+                  f"| mean iters_taken {mean_taken:.2f} of budget {budget} "
+                  f"(fixed {iters}; {cov}; "
+                  f"saved {(e2e-ada)*1e3:+.1f} ms/frame)")
     return 0
 
 

@@ -21,7 +21,13 @@ span referential integrity (obs/validate.py ``check_span_integrity``):
 unique span_ids, parent_ids resolving within the file, non-empty
 trace_ids.
 
-Usage: python scripts/check_events.py <events.jsonl | run_dir> [...]
+``iter_policy.json`` artifacts (``cli converge --emit-policy``) are also
+accepted: any ``*.json`` path whose top-level ``kind`` is ``iter_policy``
+is held against the policy schema instead (obs/validate.py
+``check_iter_policy``): bucket coverage, tau > 0, budget within the
+recorded valid_iters, provenance fields present.
+
+Usage: python scripts/check_events.py <events.jsonl | run_dir | iter_policy.json> [...]
 """
 
 import os

@@ -17,6 +17,16 @@ decision table without re-running the model:
    exit 0 with a non-empty decision table; the eval table must carry
    EPE-delta columns (the GT-backed what-if), the serve one residual
    statistics per shape bucket.
+4. **adaptive leg (r16)** — close the loop the simulator only predicts:
+   emit a policy from the eval leg's recorded curves (`cli converge
+   --emit-policy`, tau picked so every curve converges inside the
+   budget), schema-lint it, then RE-RUN eval and loadtest with
+   ``--iter_policy``. The compiled early exit must actually save
+   iterations (per-frame/request ``iters_taken`` present, p95 < budget,
+   mean strictly below the fixed trip count), the slo rollups must carry
+   the per-bucket ``iters`` gauges, and the adaptive run's final EPE must
+   stay within the table's predicted ``epe_delta`` (+ a small in-graph/
+   simulator boundary slack).
 
 Each leg appends a dated JSON record to
 ``runs/converge_drill/drills.jsonl``; exit non-zero if any check failed.
@@ -178,6 +188,137 @@ def drill_serve(work):
             "error": "; ".join(errors) or None}
 
 
+def _final_epes(curves):
+    """Per-frame final in-graph EPE from recorded converge events."""
+    return [float(c["epe"][-1]) for c in curves if c.get("epe")]
+
+
+def drill_adaptive(work, eval_rec):
+    """Emit a policy from the eval leg's curves, re-run eval + loadtest
+    with it, and assert the compiled early exit saved iterations without
+    giving up the predicted quality."""
+    if not eval_rec.get("ok"):
+        return {"drill": "adaptive", "ok": False,
+                "error": "eval leg failed; no curves to emit a policy from"}
+    src = eval_rec["run_dir"]
+    _, curves = _curves(src)
+    errors = []
+
+    # Pick tau from the recorded curves so every curve converges at least
+    # one iteration before the recorded budget: the smallest threshold
+    # strictly above every curve's best pre-final residual. Deterministic,
+    # and independent of the (random-weight) model's absolute scale.
+    best = [min(float(v) for v in c["residual"][:-1]) for c in curves]
+    tau = float(f"{max(best) * 1.01 + 1e-6:.6g}")
+    policy_path = os.path.join(work, "iter_policy.json")
+    rc, out = _run([sys.executable, "-m", "raft_stereo_tpu.cli",
+                    "converge", src, "--emit-policy", policy_path,
+                    "--policy-tau", repr(tau), "--taus", repr(tau),
+                    "--json", "-"])
+    if rc != 0:
+        return {"drill": "adaptive", "ok": False,
+                "error": f"emit-policy rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    with open(policy_path) as f:
+        policy = json.load(f)
+    lint = _lint(policy_path)
+    if lint:
+        errors.append(f"policy lint: {lint[:3]}")
+    table = json.loads(out[out.index("{"):]).get("table", [])
+    pooled = next((r for r in table
+                   if r["bucket"] == "*" and abs(r["tau"] - tau) < 1e-9),
+                  None)
+    epe_delta_pred = (pooled or {}).get("epe_delta_mean") or 0.0
+    entries = list(policy.get("buckets", {}).values())
+    if "default" in policy:
+        entries.append(policy["default"])
+    budget = max(int(e["budget"]) for e in entries)
+
+    # adaptive EVAL re-run: same dataset, the policy drives the trip count
+    run_dir = os.path.join(work, "runs", "eval_adaptive")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "eval",
+        "--dataset", "things", "--data_root", os.path.join(work, "data"),
+        "--run_dir", run_dir, "--stream", "on", "--iter_epe",
+        "--valid_iters", str(ITERS), "--iter_policy", policy_path,
+        "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "adaptive", "ok": False,
+                "error": f"adaptive eval rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    _, acurves = _curves(run_dir)
+    taken = [int(c["iters_taken"]) for c in acurves if "iters_taken" in c]
+    if len(taken) != len(acurves) or not taken:
+        errors.append("adaptive eval curves missing iters_taken")
+    else:
+        p95 = sorted(taken)[min(len(taken) - 1,
+                                int(round(0.95 * (len(taken) - 1))))]
+        if p95 >= budget:
+            errors.append(f"iters_taken p95 {p95} not below budget {budget}")
+        if sum(taken) / len(taken) >= ITERS:
+            errors.append(f"mean iters_taken {sum(taken) / len(taken):.2f} "
+                          f"not below the fixed trip count {ITERS}")
+    fixed_epe = _final_epes(curves)
+    adaptive_epe = _final_epes(acurves)
+    epe_excess = None
+    if fixed_epe and adaptive_epe:
+        measured_delta = (sum(adaptive_epe) / len(adaptive_epe)
+                          - sum(fixed_epe) / len(fixed_epe))
+        # slack: the simulator exits on <= tau over stored points, the
+        # graph freezes on < tau — allow a small boundary margin
+        epe_excess = measured_delta - max(float(epe_delta_pred), 0.0)
+        if epe_excess > 0.05:
+            errors.append(
+                f"adaptive EPE delta {measured_delta:.4f}px exceeds the "
+                f"table's prediction {epe_delta_pred:.4f}px by "
+                f"{epe_excess:.4f}px")
+    else:
+        errors.append("missing final-EPE series for the quality check")
+    if _lint(run_dir):
+        errors.append(f"adaptive eval lint: {_lint(run_dir)[:3]}")
+
+    # adaptive SERVE re-run: the same policy drives the AOT bucket cache
+    lt_dir = os.path.join(work, "loadtest_adaptive")
+    rc, out = _run([
+        sys.executable, "-m", "raft_stereo_tpu.cli", "loadtest",
+        "--run_dir", lt_dir, "--no_baseline", "--no_progress",
+        "--shapes", "48x96", "64x128",
+        "--clients", "3", "--requests_per_client", "2",
+        "--video_streams", "0", "--max_batch", "2", "--window", "2",
+        "--iters", str(ITERS), "--iter_policy", policy_path,
+        "--hidden_dims", "32", "32", "32"])
+    if rc != 0:
+        return {"drill": "adaptive", "ok": False,
+                "error": f"adaptive loadtest rc={rc}",
+                "tail": "\n".join(out.splitlines()[-6:])}
+    serve_dir = os.path.join(lt_dir, "serve")
+    records, scurves = _curves(serve_dir)
+    req_taken = [int(r["iters_taken"]) for r in records
+                 if r.get("event") == "request"
+                 and r.get("status") == "ok" and "iters_taken" in r]
+    if not req_taken:
+        errors.append("no served request event carries iters_taken")
+    elif max(req_taken) > budget:
+        errors.append(f"served iters_taken max {max(req_taken)} exceeds "
+                      f"budget {budget}")
+    elif sum(req_taken) / len(req_taken) >= ITERS:
+        errors.append(f"served mean iters_taken not below the fixed "
+                      f"trip count {ITERS}")
+    if not any(e.get("event") == "slo" and "iters" in e for e in records):
+        errors.append("no slo rollup carries the per-bucket iters gauges")
+    if _lint(serve_dir):
+        errors.append(f"adaptive serve lint: {_lint(serve_dir)[:3]}")
+
+    return {"drill": "adaptive", "ok": not errors,
+            "policy": {"tau": round(tau, 6), "budget": budget,
+                       "buckets": sorted(policy.get("buckets", {})),
+                       "default": "default" in policy},
+            "eval_iters_taken": taken, "serve_iters_taken": req_taken,
+            "epe_delta_pred": epe_delta_pred,
+            "epe_excess": epe_excess,
+            "error": "; ".join(errors) or None}
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="convergence-observatory rehearsal over real tiny runs "
@@ -192,7 +333,9 @@ def main(argv=None):
     work = tempfile.mkdtemp(prefix="converge_drill_")
     t0 = time.monotonic()
     try:
-        records = [drill_eval(work), drill_serve(work)]
+        eval_rec = drill_eval(work)
+        records = [eval_rec, drill_serve(work), drill_adaptive(work,
+                                                              eval_rec)]
     finally:
         if args.keep_work:
             print(f"work tree kept: {work}")

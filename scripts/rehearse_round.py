@@ -76,7 +76,12 @@ rehearsal:
   ``converge`` curves that lint clean, and ``cli converge <run_dir>``
   must replay them into a non-empty early-exit decision table
   (EPE-delta columns on the GT-backed eval leg) without re-running the
-  model.
+  model. The r16 adaptive leg closes the loop: ``cli converge
+  --emit-policy`` distills the recorded eval run into a linted
+  ``iter_policy.json``, the eval and loadtest re-run with
+  ``--iter_policy``, and every request/frame must report
+  ``iters_taken`` with p95 strictly under the policy budget at an EPE
+  within the table's prediction.
 * **numerics** — the numerics-observatory rehearsal (r15): ``python
   scripts/numerics_drill.py`` — seeded faults must come back with the
   CORRECT attribution: an injected all-NaN train batch names its step

@@ -1,0 +1,402 @@
+"""Adaptive iteration: the compiled early-exit from recorded
+convergence policies (ISSUE 17).
+
+* the zero-threshold pin: ``adaptive_tau=0.0`` never freezes a sample,
+  so the adaptive program's flow is bitwise-equal to the fixed scan and
+  every sample reports the full budget;
+* ``adaptive_tau=None`` keeps the traced test-mode program byte-identical
+  to the prior one (the no-policy HLO pin), and an ``adaptive=False``
+  predictor with a policy on hand stays bitwise-equal to a plain one;
+* masked-scan freeze semantics vs a NumPy oracle on the recorded fixed
+  curves: per-sample iters_taken, the strict ``r < tau`` exit, the
+  ``min_iters`` floor, frozen iterations recording 0.0 residual rows;
+* ``adaptive_mode="while_loop"`` (whole-batch dynamic trip) agrees with
+  the masked scan sample-for-sample;
+* policy schema lint: a doctored ``iter_policy.json`` fails at load with
+  a named reason — entry/provenance tau mismatch, budget above the
+  recorded budget, τ=0, missing coverage — and fails StereoPredictor
+  construction, never silently mis-budgets the graph;
+* StereoPredictor policy resolution: padded-bucket lookup, the budget
+  capping the requested trip count, uncovered buckets falling back to
+  the fixed path, and the adaptive guards (no policy / numerics taps);
+* serving: adaptive and fixed flavors coexist in ONE server — the
+  policy digest is part of the compiled-program identity (BucketKey's
+  ``@digest`` label), covered requests retire with iters_taken + the
+  slo "iters" rollup + Prometheus gauges, uncovered ones stay on the
+  fixed path with none of that.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_stereo_tpu.config import RAFTStereoConfig
+from raft_stereo_tpu.inference import StereoPredictor
+from raft_stereo_tpu.models import create_model, init_model
+from raft_stereo_tpu.obs import Telemetry, read_events
+from raft_stereo_tpu.obs import converge as cv
+from raft_stereo_tpu.obs.validate import check_iter_policy, check_path
+
+H, W = 32, 64          # /32-exact: raw == padded, bucket "32x64"
+ITERS = 3
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = RAFTStereoConfig(hidden_dims=(32, 32, 32))
+    model, variables = init_model(jax.random.PRNGKey(0), cfg, (1, H, W, 3))
+    return cfg, model, variables
+
+
+def _frames(seeds, h=H, w=W):
+    rng_pairs = [np.random.default_rng(s) for s in seeds]
+    im1 = np.stack([r.integers(0, 255, (h, w, 3)).astype(np.float32)
+                    for r in rng_pairs])
+    im2 = np.stack([r.integers(0, 255, (h, w, 3)).astype(np.float32)
+                    for r in rng_pairs])
+    return im1, im2
+
+
+def _entry(tau, budget, min_iters=1, recorded=None):
+    """One schema-valid policy entry (provenance row included)."""
+    return {"tau": tau, "budget": budget, "min_iters": min_iters,
+            "provenance": {"source": "eval:test",
+                           "row": {"tau": tau,
+                                   "budget": recorded or budget}}}
+
+
+def _policy(buckets, default=None):
+    doc = {"kind": "iter_policy", "version": 1, "source_run": "runs/test",
+           "buckets": buckets}
+    if default is not None:
+        doc["default"] = default
+    assert check_iter_policy(doc) == []
+    return doc
+
+
+# --------------------------------------------------- model-level pins
+
+def test_tau_zero_is_bitwise_parity(tiny):
+    """τ=0 with strict ``r < tau`` freezes nothing: flow bitwise-equal to
+    the fixed scan, full budget reported for every sample."""
+    _, model, variables = tiny
+    im1, im2 = _frames([0, 1])
+    fixed_lr, fixed_up, fixed_res = model.apply(
+        variables, im1, im2, iters=ITERS, test_mode=True,
+        iter_metrics="per_sample")
+    lr, up, res, taken = model.apply(
+        variables, im1, im2, iters=ITERS, test_mode=True,
+        iter_metrics="per_sample", adaptive_tau=0.0)
+    np.testing.assert_array_equal(np.asarray(up), np.asarray(fixed_up))
+    np.testing.assert_array_equal(np.asarray(lr), np.asarray(fixed_lr))
+    np.testing.assert_array_equal(np.asarray(res), np.asarray(fixed_res))
+    assert list(np.asarray(taken)) == [ITERS, ITERS]
+
+
+def test_adaptive_none_keeps_prior_hlo(tiny):
+    """``adaptive_tau=None`` (every pre-policy call site) must leave the
+    traced program byte-identical to the prior plain test-mode one."""
+    _, model, variables = tiny
+    spec = jax.ShapeDtypeStruct((1, H, W, 3), np.float32)
+    vspec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), variables)
+
+    def run_off(v, a, b):
+        return model.apply(v, a, b, iters=ITERS, test_mode=True,
+                           adaptive_tau=None, adaptive_min_iters=1)
+
+    def run_prior(v, a, b):
+        return model.apply(v, a, b, iters=ITERS, test_mode=True)
+
+    run_off.__name__ = run_prior.__name__ = "forward"
+    text_off = jax.jit(run_off).lower(vspec, spec, spec).as_text()
+    text_prior = jax.jit(run_prior).lower(vspec, spec, spec).as_text()
+    assert text_off == text_prior
+
+
+def _oracle_taken(res_fixed, tau, min_iters, budget):
+    """NumPy twin of the freeze rule: after applied update i (1-indexed,
+    residual row i-1), the sample freezes iff r < tau and i >= min_iters;
+    iters_taken = the freezing i, else the full budget."""
+    taken = []
+    for j in range(res_fixed.shape[1]):
+        t = budget
+        for i in range(min_iters, budget + 1):
+            if res_fixed[i - 1, j] < tau:
+                t = i
+                break
+        taken.append(t)
+    return taken
+
+
+def test_masked_scan_freeze_matches_numpy_oracle(tiny):
+    _, model, variables = tiny
+    im1, im2 = _frames([3, 4, 5])
+    _, _, res_fixed = model.apply(
+        variables, im1, im2, iters=ITERS, test_mode=True,
+        iter_metrics="per_sample")
+    res_fixed = np.asarray(res_fixed, np.float64)
+    # a tau strictly inside the recorded residual range exercises a real
+    # mid-budget freeze (residual curves of random weights vary by sample)
+    tau = float(np.median(res_fixed[:-1]))
+    _, _, res_a, taken = model.apply(
+        variables, im1, im2, iters=ITERS, test_mode=True,
+        iter_metrics="per_sample", adaptive_tau=tau)
+    res_a, taken = np.asarray(res_a), list(np.asarray(taken))
+    assert taken == _oracle_taken(res_fixed, tau, 1, ITERS)
+    assert min(taken) < ITERS        # the chosen tau did freeze something
+    for j, t in enumerate(taken):
+        # applied iterations record the fixed curve's rows ...
+        np.testing.assert_array_equal(res_a[:t, j],
+                                      np.asarray(res_fixed)[:t, j])
+        # ... frozen ones record 0.0 padding
+        assert np.all(res_a[t:, j] == 0.0)
+    # the min_iters floor outranks an always-passing threshold
+    _, _, _, floored = model.apply(
+        variables, im1, im2, iters=ITERS, test_mode=True,
+        iter_metrics="per_sample", adaptive_tau=1e9,
+        adaptive_min_iters=2)
+    assert list(np.asarray(floored)) == [2, 2, 2]
+
+
+def test_while_loop_matches_masked_scan(tiny):
+    cfg, model, variables = tiny
+    wl = create_model(dataclasses.replace(cfg,
+                                          adaptive_mode="while_loop"))
+    im1, im2 = _frames([3, 4, 5])
+    _, _, res_fixed = model.apply(
+        variables, im1, im2, iters=ITERS, test_mode=True,
+        iter_metrics="per_sample")
+    tau = float(np.median(np.asarray(res_fixed)[:-1]))
+    out_ms = model.apply(variables, im1, im2, iters=ITERS, test_mode=True,
+                         iter_metrics="per_sample", adaptive_tau=tau)
+    out_wl = wl.apply(variables, im1, im2, iters=ITERS, test_mode=True,
+                      iter_metrics="per_sample", adaptive_tau=tau)
+    for a, b in zip(out_ms, out_wl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    assert list(np.asarray(out_ms[-1])) == list(np.asarray(out_wl[-1]))
+
+
+# ------------------------------------------------------ policy lint
+
+def test_policy_lint_catches_doctored_policies(tmp_path):
+    good = _policy({"32x64": _entry(0.05, 3)})
+    assert check_iter_policy(good) == []
+
+    def errs(mutate):
+        doc = json.loads(json.dumps(good))
+        mutate(doc)
+        return check_iter_policy(doc)
+
+    def set_tau(doc):
+        doc["buckets"]["32x64"]["tau"] = 0.2      # != provenance row
+
+    assert any("provenance row tau" in e for e in errs(set_tau))
+
+    def inflate(doc):
+        doc["buckets"]["32x64"]["budget"] = 9     # > recorded budget 3
+
+    assert any("exceeds the recorded iteration budget" in e
+               for e in errs(inflate))
+
+    def zero_tau(doc):
+        doc["buckets"]["32x64"]["tau"] = 0.0
+        doc["buckets"]["32x64"]["provenance"]["row"]["tau"] = 0.0
+
+    assert any("tau must be > 0" in e for e in errs(zero_tau))
+    assert any("no bucket coverage" in e
+               for e in errs(lambda d: d["buckets"].clear()))
+    assert any("not 'HxW'" in e for e in errs(
+        lambda d: d["buckets"].update({"32x": _entry(0.05, 3)})))
+    assert any("min_iters" in e for e in errs(
+        lambda d: d["buckets"]["32x64"].update(min_iters=7)))
+    assert any("kind" in e for e in errs(
+        lambda d: d.update(kind="nope")))
+
+    # load_policy raises with the first named reason; a predictor handed
+    # the doctored artifact must fail at construction
+    doctored = json.loads(json.dumps(good))
+    doctored["buckets"]["32x64"]["budget"] = 9
+    path = tmp_path / "iter_policy.json"
+    path.write_text(json.dumps(doctored))
+    with pytest.raises(ValueError, match="exceeds the recorded"):
+        cv.load_policy(str(path))
+    with pytest.raises(ValueError, match="exceeds the recorded"):
+        StereoPredictor(RAFTStereoConfig(), {}, iter_policy=str(path))
+
+
+# ------------------------------------------------- predictor plumbing
+
+@pytest.fixture(scope="module")
+def pred_fixed(tiny):
+    cfg, _, variables = tiny
+    return StereoPredictor(cfg, variables, valid_iters=ITERS,
+                           converge=True)
+
+
+@pytest.fixture(scope="module")
+def pred_adaptive(tiny):
+    """Policy whose tiny tau never fires: the parity flavor."""
+    cfg, _, variables = tiny
+    policy = _policy({f"{H}x{W}": _entry(1e-9, ITERS)})
+    return StereoPredictor(cfg, variables, valid_iters=ITERS,
+                           iter_policy=policy)
+
+
+def test_predictor_guards(tiny):
+    cfg, _, variables = tiny
+    with pytest.raises(ValueError, match="needs an iter_policy"):
+        StereoPredictor(cfg, variables, adaptive=True)
+    policy = _policy({f"{H}x{W}": _entry(0.05, ITERS)})
+    with pytest.raises(ValueError, match="numerics taps"):
+        StereoPredictor(cfg, variables, iter_policy=policy, numerics=True)
+
+
+def test_predictor_tiny_tau_parity_and_aux(pred_fixed, pred_adaptive):
+    """A never-firing tau leaves the flow bitwise-equal to the fixed
+    predictor while the aux gains the full-budget iters_taken."""
+    im1, im2 = _frames([7, 8])
+    flow_f = pred_fixed(im1, im2, ITERS)
+    flow_a = pred_adaptive(im1, im2, ITERS)
+    np.testing.assert_array_equal(flow_a, flow_f)
+    assert pred_adaptive.adaptive and not pred_fixed.adaptive
+    assert pred_adaptive.policy_digest
+    aux = pred_adaptive.take_aux()
+    assert set(aux) == {"residual", "iters_taken"}
+    assert list(aux["iters_taken"]) == [ITERS, ITERS]
+    assert aux["residual"].shape == (ITERS, 2)
+
+
+def test_predictor_budget_caps_and_policy_entry(tiny):
+    cfg, _, variables = tiny
+    policy = _policy({f"{H}x{W}": _entry(1e9, 2, recorded=ITERS)})
+    pred = StereoPredictor(cfg, variables, valid_iters=ITERS,
+                           iter_policy=policy)
+    # padded-bucket resolution: a 30x60 raw frame lands in 32x64
+    doc = pred.policy_entry(30, 60)
+    assert doc is not None and doc["budget"] == 2
+    assert pred.policy_entry(40, 80) is None     # 64x96: uncovered
+    im1, im2 = _frames([9])
+    pred(im1, im2, ITERS)                        # asks 3, budget caps at 2
+    aux = pred.take_aux()
+    # a huge tau freezes right after the min_iters floor
+    assert list(aux["iters_taken"]) == [1]
+    assert aux["residual"].shape == (2, 1)
+
+
+def test_predictor_uncovered_bucket_falls_back_to_fixed(pred_adaptive,
+                                                        pred_fixed):
+    """No bucket, no default: the call runs the fixed program and the
+    aux carries no iters_taken."""
+    im1, im2 = _frames([11], h=40, w=80)         # pads to 64x96
+    flow_a = pred_adaptive(im1, im2, ITERS)
+    flow_f = pred_fixed(im1, im2, ITERS)
+    np.testing.assert_array_equal(flow_a, flow_f)
+    assert set(pred_adaptive.take_aux()) == {"residual"}
+
+
+def test_adaptive_false_with_policy_stays_fixed(tiny, pred_fixed):
+    """adaptive=False pins the fixed path even with a policy on hand —
+    the digest is still reported for provenance, the flow is bitwise."""
+    cfg, _, variables = tiny
+    policy = _policy({f"{H}x{W}": _entry(1e9, 2)})
+    pred = StereoPredictor(cfg, variables, valid_iters=ITERS,
+                           iter_policy=policy, adaptive=False,
+                           converge=True)
+    assert not pred.adaptive and pred.policy_digest
+    im1, im2 = _frames([12])
+    np.testing.assert_array_equal(pred(im1, im2, ITERS),
+                                  pred_fixed(im1, im2, ITERS))
+    assert set(pred.take_aux()) == {"residual"}
+
+
+# ------------------------------------------------------------- serving
+
+def test_serve_cache_guards_and_bucketkey_backcompat():
+    from raft_stereo_tpu.serve import BucketKey
+    from raft_stereo_tpu.serve.cache import ExecutableCache
+    stub = {"params": {"w": np.zeros((1,), np.float32)}}
+    with pytest.raises(ValueError, match="iter_policy"):
+        ExecutableCache(RAFTStereoConfig(), stub, adaptive=True)
+    policy = _policy({"32x64": _entry(0.05, 2)})
+    with pytest.raises(ValueError, match="numerics"):
+        ExecutableCache(RAFTStereoConfig(), stub, iter_policy=policy,
+                        numerics=True)
+    cache = ExecutableCache(RAFTStereoConfig(), stub, iter_policy=policy)
+    assert cache.adaptive and cache.converge     # forced residual aux
+    assert cache.bucket_entry(32, 64)["budget"] == 2
+    assert cache.bucket_entry(64, 96) is None
+    # the 5-field key is the fixed-trip program; digest changes the label
+    key = BucketKey(32, 64, 1, 2, False)
+    assert key.policy == "" and key.label() == "32x64b1i2"
+    assert BucketKey(32, 64, 1, 2, False, "abc").label() \
+        == "32x64b1i2@abc"
+
+
+def test_serve_mixed_adaptive_and_fixed_flavors(tiny, tmp_path):
+    """One server, one policy covering one bucket: covered requests ride
+    the @digest executable and retire with iters_taken (slo rollup +
+    Prometheus gauges), uncovered ones stay on the fixed program."""
+    from raft_stereo_tpu.serve import ServeConfig, StereoServer
+    from raft_stereo_tpu.serve.http import prometheus_metrics
+    cfg, _, variables = tiny
+    policy = _policy({f"{H}x{W}": _entry(1e9, 2, recorded=ITERS)})
+    digest = cv.policy_digest(policy)
+    tel = Telemetry(str(tmp_path / "serve"), stall_deadline_s=None)
+    tel.run_start(config={"mode": "serve"})
+    server = StereoServer(
+        cfg, variables,
+        ServeConfig(max_batch=2, window=2, default_iters=ITERS,
+                    linger_s=0.0, slo_every=1, iter_policy=policy),
+        telemetry=tel)
+    try:
+        rng = np.random.default_rng(0)
+
+        def pair(h, w):
+            return (rng.random((h, w, 3)).astype(np.float32),
+                    rng.random((h, w, 3)).astype(np.float32))
+
+        res_a = [server.submit(*pair(H, W)).result(timeout=300)
+                 for _ in range(2)]
+        res_f = server.submit(*pair(40, 80)).result(timeout=300)
+    finally:
+        server.request_drain()
+        assert server.join(timeout=60)
+    stats = server.stats()
+    tel.emit("run_end", steps=3, ok=True)
+    tel.close()
+
+    for r in res_a:
+        assert r.ok and r.bucket == f"{H}x{W}b1i2@{digest}"
+        assert r.iters_taken == 1            # huge tau: freeze at the floor
+        assert r.final_residual is not None
+    assert res_f.ok and res_f.bucket == "64x96b1i3"
+    assert res_f.iters_taken is None
+
+    # slo rollup + exposition carry the per-bucket iteration gauges
+    iters = stats["iters"]
+    assert set(iters) == {f"{H}x{W}b1i2@{digest}"}
+    gauges = iters[f"{H}x{W}b1i2@{digest}"]
+    assert gauges["iters_taken_p50"] == 1.0
+    assert gauges["iters_taken_p95"] == 1.0
+    assert gauges["n"] == 2
+    text = prometheus_metrics(stats)
+    assert (f'raft_serve_iters_taken_p50'
+            f'{{bucket="{H}x{W}b1i2@{digest}"}}') in text
+    assert "raft_serve_iters_window_requests" in text
+
+    # the event stream: covered requests carry iters_taken, the fixed
+    # one does not; everything still lints
+    events = read_events(str(tmp_path / "serve" / "events.jsonl"))
+    reqs = [e for e in events if e.get("event") == "request"
+            and e.get("status") == "ok"]
+    taken = sorted(e.get("iters_taken", -1) for e in reqs)
+    assert taken == [-1, 1, 1]
+    curves = [e for e in events if e.get("event") == "converge"]
+    assert any(e.get("iters_taken") == 1 for e in curves)
+    assert check_path(str(tmp_path / "serve")) == []

@@ -15,8 +15,9 @@
 * schema v8 is additive: v1-v7-stamped records still validate, a
   v7-stamped converge record flags drift, and the converge lint catches
   malformed curves;
-* cli-drift rule v5: the build_converge_parser surface fires on a
-  seeded orphan flag.
+* cli-drift rule v7: the build_converge_parser surface fires on a
+  seeded orphan flag while the consumed policy-emission flags
+  (--emit-policy/--policy-tau) stay clean.
 """
 
 import json
@@ -612,13 +613,15 @@ def test_cli_converge_main_on_recorded_run(tmp_path, capsys):
     assert main([]) == 2
 
 
-def test_cli_drift_v5_fires_on_seeded_converge_fixture(tmp_path):
-    """Rule v5: an orphan flag on the converge surface is an error; flags
-    the obs/converge.py consumer reads stay clean."""
+def test_cli_drift_v7_fires_on_seeded_converge_fixture(tmp_path):
+    """Rule v7: an orphan flag on the converge surface is an error — the
+    fixture seeds an unconsumed adaptive-era flag (--emit-policy declared
+    but never read) next to a consumed one; flags the obs/converge.py
+    consumer reads stay clean."""
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 6
+    assert RULE_VERSIONS["cli-drift"] == 7
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "obs").mkdir(parents=True)
     (pkg / "cli.py").write_text(
@@ -627,10 +630,14 @@ def test_cli_drift_v5_fires_on_seeded_converge_fixture(tmp_path):
         "    p = argparse.ArgumentParser()\n"
         "    p.add_argument('run_dir')\n"
         "    p.add_argument('--taus')\n"
+        "    p.add_argument('--emit-policy', dest='emit_policy')\n"
+        "    p.add_argument('--policy-tau', dest='policy_tau')\n"
         "    p.add_argument('--converge_orphan')\n"
         "    return p\n")
     (pkg / "obs" / "converge.py").write_text(
         "def main(args):\n"
+        "    if args.emit_policy:\n"
+        "        return (args.emit_policy, args.policy_tau)\n"
         "    return (args.run_dir, args.taus)\n")
     findings = check_entry_surface_drift(str(tmp_path))
     errors = [f for f in findings
