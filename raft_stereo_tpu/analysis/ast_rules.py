@@ -56,12 +56,15 @@ from raft_stereo_tpu.analysis.findings import Finding
 #: --adaptive on the serve/loadtest surfaces, and the policy-emission
 #: flags (--emit-policy/--policy-tau/--policy-min-iters/--policy-margin)
 #: on the converge surface — so earlier suppressions no longer mean what
-#: they said.
+#: they said; v8 adds the fleet surface (build_fleet_parser, consumed by
+#: obs/fleet.py) plus the fleet-observatory plumbing (--no_fleet/
+#: --host_id/--heartbeat_every) on the train, serve and loadtest
+#: surfaces.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 7,
+    "cli-drift": 8,
 }
 
 # Call names (last attribute segment) that trace their function arguments.
@@ -502,6 +505,10 @@ ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # numerics-observatory replay's main
     ("build_numerics_parser", ("raft_stereo_tpu/cli.py",
                                "raft_stereo_tpu/obs/numerics.py")),
+    # fleet surface (rule v8): declared in cli.py, consumed by the
+    # fleet-rollup aggregator's main
+    ("build_fleet_parser", ("raft_stereo_tpu/cli.py",
+                            "raft_stereo_tpu/obs/fleet.py")),
 )
 
 #: modules whose own argparse surface must be self-consumed, and whose

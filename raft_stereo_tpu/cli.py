@@ -184,6 +184,21 @@ def add_train_args(parser: argparse.ArgumentParser) -> None:
                    help="emit one grad `numerics` event every N steps (a "
                         "non-finite norm vector always emits regardless, "
                         "so cadence never hides NaN provenance)")
+    fl = parser.add_argument_group(
+        "fleet observatory", "schema-v10 host identity, clock anchor and "
+        "heartbeat liveness on the event stream (obs/fleet.py; rollup: "
+        "`cli fleet <dir>`; drill: scripts/fleet_drill.py)")
+    fl.add_argument("--no_fleet", action="store_true",
+                    help="disable fleet stamping entirely: no host_id/pid "
+                         "extras, no clock_anchor, no heartbeat records — "
+                         "the stream is byte-shaped like a single-process "
+                         "run")
+    fl.add_argument("--host_id", default=None,
+                    help="host identity stamped on every record (default: "
+                         "RAFT_HOST_ID env, else <hostname>-<pid>)")
+    fl.add_argument("--heartbeat_every", type=float, default=10.0,
+                    help="trainer heartbeat cadence in seconds (0 "
+                         "disables the beats; stamping stays on)")
 
 
 def train_config(args: argparse.Namespace) -> TrainConfig:
@@ -222,6 +237,9 @@ def train_config(args: argparse.Namespace) -> TrainConfig:
         anomaly_max_skips=args.anomaly_max_skips,
         numerics=not args.no_numerics,
         numerics_every=args.numerics_every,
+        fleet=not args.no_fleet,
+        host_id=args.host_id,
+        heartbeat_every_s=args.heartbeat_every,
     )
 
 
@@ -434,6 +452,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--no_metrics", action="store_true",
                         help="disable the Prometheus GET /metrics "
                              "exposition endpoint (serve/http.py)")
+    parser.add_argument("--no_fleet", action="store_true",
+                        help="disable schema-v10 fleet stamping (host_id/"
+                             "pid extras, clock_anchor, heartbeats) on "
+                             "the telemetry stream")
+    parser.add_argument("--host_id", default=None,
+                        help="host identity stamped on every record and "
+                             "labeled on /metrics (default: RAFT_HOST_ID "
+                             "env, else <hostname>-<pid>)")
+    parser.add_argument("--heartbeat_every", type=float, default=10.0,
+                        help="serve heartbeat cadence in seconds (0 "
+                             "disables the beats; stamping stays on)")
     add_serve_args(parser)
     add_model_args(parser)
     return parser
@@ -461,6 +490,27 @@ def build_doctor_parser() -> argparse.ArgumentParser:
                         help="run directory (or events.jsonl path)")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON instead of text")
+    return parser
+
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    """The ``cli fleet`` flag surface (consumed by obs/fleet.py)."""
+    parser = argparse.ArgumentParser(
+        prog="cli fleet",
+        description="Merge N per-host run dirs into one clock-aligned "
+                    "rollup (per-host step-time/throughput, skew table, "
+                    "heartbeat gaps, cross-host trace joins) plus a "
+                    "merged Perfetto timeline with a process-group per "
+                    "host")
+    parser.add_argument("fleet_dir",
+                        help="directory whose child directories are the "
+                             "per-host run dirs (each holding an "
+                             "events.jsonl)")
+    parser.add_argument("--out", default=None,
+                        help="merged timeline output path (default "
+                             "<fleet_dir>/fleet_timeline.json)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the rollup as JSON instead of text")
     return parser
 
 
@@ -560,6 +610,17 @@ def build_loadtest_parser() -> argparse.ArgumentParser:
                         help="skip the sequential-predict baseline phase")
     parser.add_argument("--no_progress", action="store_true",
                         help="suppress LOADTEST progress lines")
+    parser.add_argument("--no_fleet", action="store_true",
+                        help="disable schema-v10 fleet stamping (host_id/"
+                             "pid extras, clock_anchor, heartbeats) on "
+                             "the telemetry streams")
+    parser.add_argument("--host_id", default=None,
+                        help="host identity stamped on every record "
+                             "(default: RAFT_HOST_ID env, else "
+                             "<hostname>-<pid>)")
+    parser.add_argument("--heartbeat_every", type=float, default=10.0,
+                        help="loadtest heartbeat cadence in seconds (0 "
+                             "disables the beats; stamping stays on)")
     add_serve_args(parser)
     add_model_args(parser)
     return parser
@@ -585,7 +646,8 @@ def _serve_main():
     if args.run_dir:
         from raft_stereo_tpu.obs import Telemetry
         from raft_stereo_tpu.obs.trace import Tracer
-        tel = Telemetry(args.run_dir, stall_deadline_s=None)
+        tel = Telemetry(args.run_dir, stall_deadline_s=None,
+                        host_id=args.host_id, fleet=not args.no_fleet)
         Tracer(tel)  # request-lifecycle spans (attaches as tel.tracer)
         tel.run_start(config={"mode": "serve", "port": args.port,
                               "max_batch": args.max_batch,
@@ -593,6 +655,11 @@ def _serve_main():
                               "iter_policy": args.iter_policy,
                               "adaptive": args.adaptive})
     server = StereoServer(cfg, variables, serve_config(args), telemetry=tel)
+    if tel is not None:
+        # liveness beats carry the served-request counter so a fleet
+        # rollup can see a host that is up but not making progress
+        tel.start_heartbeat("serve", args.heartbeat_every,
+                            probe=lambda: {"completed": server.slo.completed})
     if args.warm_shapes:
         n = server.warmup(_parse_shapes(args.warm_shapes),
                           batch_sizes=(1, args.max_batch))
@@ -616,7 +683,8 @@ def _serve_main():
         server.reload(fresh, note=ckpt)
 
     httpd = make_http_server(server, args.host, args.port,
-                             metrics=not args.no_metrics)
+                             metrics=not args.no_metrics,
+                             host_id=tel.host_id if tel is not None else None)
     with SignalGuard() as guard:
         rc = serve_forever(server, httpd,
                            should_stop=lambda: guard.requested,
@@ -666,7 +734,8 @@ def _loadtest_main():
                           "adaptive": args.adaptive}}
     if not args.no_baseline:
         with Telemetry(os.path.join(args.run_dir, "seq"),
-                       stall_deadline_s=None) as tel_seq:
+                       stall_deadline_s=None, host_id=args.host_id,
+                       fleet=not args.no_fleet) as tel_seq:
             tel_seq.run_start(config={"mode": "loadtest-seq"})
             predictor = StereoPredictor(cfg, variables,
                                         valid_iters=args.iters,
@@ -675,11 +744,14 @@ def _loadtest_main():
         print(f"LOADTEST baseline {json.dumps(summary['sequential'])}",
               flush=True)
     tel = Telemetry(os.path.join(args.run_dir, "serve"),
-                    stall_deadline_s=None)
+                    stall_deadline_s=None, host_id=args.host_id,
+                    fleet=not args.no_fleet)
     from raft_stereo_tpu.obs.trace import Tracer
     Tracer(tel)  # request-lifecycle spans (attaches as tel.tracer)
     tel.run_start(config={"mode": "loadtest-serve"})
     server = StereoServer(cfg, variables, serve_config(args), telemetry=tel)
+    tel.start_heartbeat("loadtest", args.heartbeat_every,
+                        probe=lambda: {"completed": server.slo.completed})
     # AOT-warm every program the trace can reach — cold buckets at every
     # batch size plus the video streams' warm flavor — so the timed phase
     # measures serving, not compilation
@@ -823,7 +895,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     * ``timeline <run_dir>`` — export the run's span/event/device-trace
       timeline as Chrome/Perfetto JSON (obs/timeline.py),
     * ``doctor <run_dir>`` — rule-driven bottleneck diagnosis with
-      evidence lines (obs/doctor.py),
+      evidence lines (obs/doctor.py); pointed at a directory of per-host
+      run dirs it emits the fleet verdicts (STRAGGLER / DEAD_HOST /
+      DESYNC),
+    * ``fleet <fleet_dir>`` — merge N per-host run dirs into one
+      clock-aligned rollup + a merged Perfetto timeline with a
+      process-group per host (obs/fleet.py),
     * ``converge <run_dir>`` — the early-exit what-if simulator over a
       run's recorded convergence curves (obs/converge.py; the ROADMAP 1(b)
       decision table, computed offline),
@@ -842,7 +919,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     argv = list(sys.argv[1:] if argv is None else argv)
     commands = ("telemetry", "compare", "lint", "timeline", "doctor",
-                "converge", "numerics", "train", "eval", "serve", "loadtest")
+                "fleet", "converge", "numerics", "train", "eval", "serve",
+                "loadtest")
     if not argv or argv[0] not in commands:
         print(f"usage: python -m raft_stereo_tpu.cli {{{'|'.join(commands)}}} "
               "...", file=sys.stderr)
@@ -863,6 +941,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cmd == "doctor":
         from raft_stereo_tpu.obs.doctor import main as doctor_main
         return doctor_main(rest)
+    if cmd == "fleet":
+        from raft_stereo_tpu.obs.fleet import main as fleet_main
+        return fleet_main(rest)
     if cmd == "converge":
         from raft_stereo_tpu.obs.converge import main as converge_main
         return converge_main(rest)

@@ -322,6 +322,16 @@ class TrainConfig:
     # to the unobserved one (--no_numerics).
     numerics: bool = True
     numerics_every: int = 50
+    # Fleet observatory (obs/fleet.py, schema v10): host identity stamped
+    # on every telemetry record plus a clock_anchor at run_start and
+    # `heartbeat` liveness beats every heartbeat_every_s seconds from the
+    # trainer, so `cli fleet` can align/diagnose N training processes.
+    # host_id=None resolves to RAFT_HOST_ID env or <hostname>-<pid>;
+    # fleet=False (--no_fleet) pins the event stream byte-shaped like a
+    # single-process run (no stamps, no anchor, no beats).
+    fleet: bool = True
+    heartbeat_every_s: float = 10.0
+    host_id: Optional[str] = None
 
 
 # --- Named presets mirroring the reference's published training commands -------------

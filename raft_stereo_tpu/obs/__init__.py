@@ -21,7 +21,12 @@ The three pieces every entry point shares:
   schema-v7 ``span`` records (trainer step phases, loader produce legs,
   eval frames, serve request lifecycle); consumed by ``cli timeline``
   (obs/timeline.py), ``cli doctor`` (obs/doctor.py) and the telemetry
-  flight recorder.
+  flight recorder;
+* the fleet observatory (obs/fleet.py) — schema-v10 host identity on
+  every record, ``clock_anchor``/``heartbeat`` events, traceparent-style
+  cross-process trace propagation, and ``cli fleet`` merging N per-host
+  run dirs into one clock-aligned rollup + Perfetto timeline; ``cli
+  doctor`` grows the STRAGGLER/DEAD_HOST/DESYNC fleet verdicts.
 """
 
 from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
@@ -29,6 +34,10 @@ from raft_stereo_tpu.obs.events import (EVENT_TYPES, SCHEMA_VERSION,
                                         append_json_log, make_record,
                                         read_events, validate_events,
                                         validate_record)
+from raft_stereo_tpu.obs.fleet import (HOST_ID_ENV, TRACEPARENT_ENV,
+                                       aggregate_fleet, diagnose_fleet,
+                                       format_traceparent, parse_traceparent,
+                                       resolve_host_id)
 from raft_stereo_tpu.obs.telemetry import Telemetry
 from raft_stereo_tpu.obs.trace import (NULL_TRACER, Span, Tracer,
                                        tracer_for)
@@ -43,6 +52,8 @@ __all__ = [
     "append_json_log", "make_record", "read_events", "validate_events",
     "validate_record", "check_path", "check_paths", "Telemetry",
     "NULL_TRACER", "Span", "Tracer", "tracer_for",
+    "HOST_ID_ENV", "TRACEPARENT_ENV", "aggregate_fleet", "diagnose_fleet",
+    "format_traceparent", "parse_traceparent", "resolve_host_id",
     "format_summary", "summarize_run",
     "introspect_compiled", "compact_xla_summary", "compare_runs",
 ]

@@ -28,6 +28,12 @@ that earned it:
   device time refining disparities that had stopped moving. Evidence
   quotes "p95 converged by iter k of N" and points at ``cli converge``
   for the full threshold sweep.
+* **STRAGGLER / DEAD_HOST / DESYNC / FLEET_OK** (``fleet`` phase) — when
+  pointed at a directory of N per-host run dirs instead of one run,
+  doctor routes to the schema-v10 fleet observatory (obs/fleet.py):
+  clock-aligned cross-host verdicts naming the host whose step p95 blew
+  past the other hosts', whose heartbeats stopped without a clean
+  run_end, or whose step counter drifted from the live fleet's.
 * **NONFINITE_ORIGIN / BF16_SATURATION / GRAD_EXPLOSION /
   NUMERICS_CLEAN** (own ``numerics`` phase, additive) — the schema-v9
   numerics observatory's verdicts, in that priority order: the recorded
@@ -318,10 +324,17 @@ def diagnose(run_dir: str) -> Dict[str, Any]:
     """Diagnose one run dir; returns ``{"run_dir", "verdicts": [...]}``.
 
     ``verdicts`` holds one entry per phase with evidence; a log with
-    neither steps nor requests yields a single UNKNOWN verdict.
+    neither steps nor requests yields a single UNKNOWN verdict. A
+    directory WITHOUT its own events.jsonl but holding child run dirs
+    that have one is a fleet dir: the report routes to the fleet
+    observatory's cross-host verdicts (obs/fleet.py).
     """
     events_path = (os.path.join(run_dir, "events.jsonl")
                    if os.path.isdir(run_dir) else run_dir)
+    if os.path.isdir(run_dir) and not os.path.exists(events_path):
+        from raft_stereo_tpu.obs import fleet
+        if fleet.discover_runs(run_dir):
+            return fleet.diagnose_fleet(run_dir)
     records = read_events(events_path)
     verdicts = [v for v in (_diagnose_train(records),
                             _diagnose_serve(records),

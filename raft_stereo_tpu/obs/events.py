@@ -27,7 +27,7 @@ import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 # Back-compat: every schema version whose artifacts are still readable.
 # v1 -> v2 (the xla_memory/xla_cost introspection events), v2 -> v3 (the
@@ -38,11 +38,15 @@ SCHEMA_VERSION = 9
 # convergence-observatory `converge` event; the `slo` quality fields ride
 # as optional extras) and v8 -> v9 (the numerics-observatory `numerics`
 # event; the `anomaly` top-leaf attribution and the `slo` output-range
-# gauges ride as optional extras) were purely ADDITIVE — no earlier event
-# changed its required fields — so pre-existing runs/*/events.jsonl lint
-# clean: an older record is validated against its own surface (it just
-# may not use events introduced later).
-SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+# gauges ride as optional extras) and v9 -> v10 (the fleet-observatory
+# events: `heartbeat` liveness beats and the `clock_anchor`
+# monotonic-to-wall mapping; host identity — host_id/pid/mesh — rides on
+# every record as optional extras stamped by the Telemetry bus) were
+# purely ADDITIVE — no earlier event changed its required fields — so
+# pre-existing runs/*/events.jsonl lint clean: an older record is
+# validated against its own surface (it just may not use events
+# introduced later).
+SUPPORTED_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 # Events introduced after schema v1; a record stamped with an older schema
 # than its event's introduction is drift (a writer forgot the bump).
@@ -62,6 +66,8 @@ _EVENT_MIN_VERSION: Dict[str, int] = {
     "flightrec": 7,
     "converge": 8,
     "numerics": 9,
+    "heartbeat": 10,
+    "clock_anchor": 10,
 }
 
 # event type -> payload fields REQUIRED at this schema version. Extra fields
@@ -181,6 +187,23 @@ EVENT_TYPES: Dict[str, tuple] = {
     # attribution) and the v9 `slo` quality gauges optional per-bucket
     # output-range percentiles (serve output drift).
     "numerics": ("source", "kind"),
+    # Fleet observatory (obs/fleet.py, schema v10). `heartbeat`: a
+    # liveness beat on cadence from each long-lived role in a process
+    # (`role` is "trainer"/"loader"/"serve"/...), `seq` a per-role
+    # strictly-increasing counter so the aggregator can detect gaps
+    # without trusting wall clocks; `every_s` (the configured cadence)
+    # and a `step` snapshot ride along as extras. `clock_anchor`: the
+    # monotonic-to-wall mapping sampled at one instant during run_start —
+    # `monotonic` is the record's own `t` (seconds since telemetry
+    # opened), `wall` the epoch seconds read back-to-back with it — so
+    # `cli fleet` can place N processes' `t` axes on one aligned clock
+    # offline. Both carry `host_id` as a required field; ALL records
+    # additionally gain optional `host_id`/`pid` (and mesh `coords`)
+    # extras stamped by the Telemetry bus when fleet stamping is on.
+    # Cross-file cadence/anchor integrity is linted by obs/validate.py
+    # check_fleet_integrity.
+    "heartbeat": ("host_id", "role", "seq"),
+    "clock_anchor": ("host_id", "monotonic", "wall"),
     "run_end": ("steps",),
 }
 

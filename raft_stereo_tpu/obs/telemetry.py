@@ -26,13 +26,21 @@ Three observers ride on the bus:
   (TPU does; CPU returns nothing and the record carries ``stats: {}``).
 * **Flight recorder** — the last N records (and, when a tracer is
   attached, its span ring) are mirrored in memory and dumped to
-  ``<run_dir>/flightrec-<ts>.jsonl`` when something goes wrong: the stall
-  watchdog firing, an ``anomaly``/``preempt`` record landing, the crash
-  path (:meth:`error`), or an explicit drain. Postmortems then carry the
-  last seconds at full resolution even when steady-state sampling is
-  coarse; each dump leaves a ``flightrec`` record on the bus pointing at
-  the side file. Rate-limited per reason so a flapping watchdog cannot
-  fill the disk.
+  ``<run_dir>/flightrec-<host>-<ts>.jsonl`` when something goes wrong:
+  the stall watchdog firing, an ``anomaly``/``preempt`` record landing,
+  the crash path (:meth:`error`), or an explicit drain. Postmortems then
+  carry the last seconds at full resolution even when steady-state
+  sampling is coarse; each dump leaves a ``flightrec`` record on the bus
+  pointing at the side file. Rate-limited per reason so a flapping
+  watchdog cannot fill the disk. The ``host_id`` in the filename keeps N
+  processes sharing a run dir from clobbering each other's dumps.
+* **Fleet stamping** (schema v10, obs/fleet.py) — every record gains the
+  process's ``host_id``/``pid`` (and mesh ``coords`` when given), a
+  ``clock_anchor`` record lands at run_start (the monotonic-to-wall
+  mapping ``cli fleet`` aligns N processes' ``t`` axes with), and
+  :meth:`start_heartbeat` runs liveness beats on cadence per role.
+  ``fleet=False`` turns all of it off — the stream is then byte-shaped
+  like a single-process run (the ``--no_fleet`` bitwise pin).
 """
 
 from __future__ import annotations
@@ -40,13 +48,15 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 import time
 import traceback
 from collections import deque
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 from raft_stereo_tpu.obs.events import make_record, append_json_log
+from raft_stereo_tpu.obs.fleet import TRACEPARENT_ENV, resolve_host_id
 
 logger = logging.getLogger(__name__)
 
@@ -59,6 +69,13 @@ _FIRST_STEP_GRACE = 10.0
 # one dump per episode is the useful one).
 _FLIGHT_RING = 256
 _FLIGHT_MIN_INTERVAL_S = 30.0
+
+# A heartbeat thread that wakes this many cadence intervals late reports
+# itself: the host is wedged enough that even a daemon timer could not
+# run, which is exactly what the fleet aggregator's DEAD_HOST deadline
+# (obs/fleet.py DEAD_HOST_GAP_BEATS) looks for offline — the anomaly
+# rides the flight-recorder trigger so the postmortem has the window.
+_HEARTBEAT_GAP_FACTOR = 3.0
 
 # --- process-global compile-hook dispatch ----------------------------------
 _hook_lock = threading.Lock()
@@ -101,7 +118,9 @@ class Telemetry:
                  stall_deadline_s: Optional[float] = None,
                  first_step_grace: float = _FIRST_STEP_GRACE,
                  watch_interval_s: Optional[float] = None,
-                 flightrec_min_interval_s: float = _FLIGHT_MIN_INTERVAL_S):
+                 flightrec_min_interval_s: float = _FLIGHT_MIN_INTERVAL_S,
+                 host_id: Optional[str] = None, fleet: bool = True,
+                 coords: Optional[Sequence[int]] = None):
         self.run_dir = run_dir
         self.run_name = run_name or os.path.basename(
             os.path.normpath(run_dir)) or "run"
@@ -122,6 +141,12 @@ class Telemetry:
         self._stalled = False
         self._stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        # fleet stamping (schema v10): host identity on every record;
+        # fleet=False restores the single-process v9-shaped stream
+        self.fleet = bool(fleet)
+        self.host_id = resolve_host_id(host_id) if self.fleet else None
+        self.coords = list(coords) if coords is not None else None
+        self._heartbeats: list = []
         # flight recorder: recent-record mirror + attached tracer
         self.tracer = None
         self._recent: "deque" = deque(maxlen=_FLIGHT_RING)
@@ -143,6 +168,11 @@ class Telemetry:
     def emit(self, event: str, **payload: Any) -> None:
         """Append one record; never raises (fail-open, logged once)."""
         rec = make_record(event, t=time.monotonic() - self._t0, **payload)
+        if self.host_id is not None:
+            rec.setdefault("host_id", self.host_id)
+            rec.setdefault("pid", os.getpid())
+            if self.coords is not None:
+                rec.setdefault("coords", self.coords)
         try:
             with self._lock:
                 if self._closed:
@@ -190,17 +220,22 @@ class Telemetry:
         tracer = self.tracer
         spans = tracer.snapshot() if tracer is not None else []
         ts = time.strftime("%Y%m%dT%H%M%S")
-        path = os.path.join(self.run_dir, f"flightrec-{ts}.jsonl")
+        # host-prefixed so N processes sharing a run dir cannot clobber
+        # each other's dumps (fleet=False keeps the legacy name)
+        tag = "" if self.host_id is None else \
+            re.sub(r"[^A-Za-z0-9_.-]+", "_", self.host_id) + "-"
+        path = os.path.join(self.run_dir, f"flightrec-{tag}{ts}.jsonl")
         n = 1
         while os.path.exists(path):  # two dumps in one second
             path = os.path.join(
-                self.run_dir, f"flightrec-{ts}-{n}.jsonl")
+                self.run_dir, f"flightrec-{tag}{ts}-{n}.jsonl")
             n += 1
         try:
             with open(path, "w") as f:
                 f.write(json.dumps({
                     "kind": "flightrec", "reason": reason,
-                    "run": self.run_name, "t": round(now - self._t0, 6),
+                    "run": self.run_name, "host_id": self.host_id,
+                    "t": round(now - self._t0, 6),
                     "events": len(events), "spans": len(spans)}) + "\n")
                 # the payload rides nested: records have their own `kind`
                 # fields (anomaly), which must not clobber the envelope
@@ -229,6 +264,8 @@ class Telemetry:
         self._stop.set()
         if self._watchdog is not None:
             self._watchdog.join(timeout=2.0)
+        for t in self._heartbeats:
+            t.join(timeout=2.0)
         _active_instances.discard(self)
         with self._lock:
             self._closed = True
@@ -258,8 +295,60 @@ class Telemetry:
     def run_start(self, config: Optional[Dict[str, Any]] = None,
                   **payload: Any) -> None:
         payload.setdefault("devices", _device_info())
+        if self.host_id is not None:
+            # a launcher's trace envelope (scripts/fleet_drill.py-style
+            # subprocess launches) joins this run to the parent span
+            envelope = os.environ.get(TRACEPARENT_ENV)
+            if envelope:
+                payload.setdefault("traceparent", envelope)
         self.emit("run_start", run=self.run_name,
                   config=config or {}, **payload)
+        if self.host_id is not None:
+            # monotonic + wall sampled back-to-back: the offset `cli
+            # fleet` aligns this process's `t` axis with (wall = t +
+            # (wall - monotonic))
+            mono, wall = time.monotonic(), time.time()
+            self.emit("clock_anchor", host_id=self.host_id,
+                      monotonic=round(mono - self._t0, 6),
+                      wall=round(wall, 6))
+
+    def start_heartbeat(self, role: str, every_s: float,
+                        probe=None) -> Optional[threading.Thread]:
+        """Liveness beats on cadence from a daemon thread: one schema-v10
+        ``heartbeat`` record per ``every_s`` seconds with a per-role
+        strictly-increasing ``seq`` (the aggregator detects gaps without
+        trusting wall clocks). ``probe()`` -> dict of extras riding each
+        beat (e.g. a step snapshot); probe errors are swallowed —
+        fail-open like the rest of the bus. No-op (returns None) when
+        fleet stamping is off or the cadence is non-positive."""
+        if self.host_id is None or not every_s or every_s <= 0:
+            return None
+        t = threading.Thread(
+            target=self._beat, args=(str(role), float(every_s), probe),
+            name=f"telemetry-heartbeat-{role}", daemon=True)
+        t.start()
+        self._heartbeats.append(t)
+        return t
+
+    def _beat(self, role: str, every_s: float, probe) -> None:
+        seq = 0
+        last = time.monotonic()
+        while not self._stop.wait(every_s):
+            now = time.monotonic()
+            gap, last = now - last, now
+            extras: Dict[str, Any] = {}
+            if probe is not None:
+                try:
+                    extras = dict(probe() or {})
+                except Exception:
+                    extras = {}
+            self.emit("heartbeat", host_id=self.host_id, role=role,
+                      seq=seq, every_s=every_s, **extras)
+            if seq > 0 and gap > _HEARTBEAT_GAP_FACTOR * every_s:
+                # rides the anomaly -> flight-recorder trigger in emit()
+                self.emit("anomaly", kind="heartbeat_gap", role=role,
+                          gap_s=round(gap, 3), every_s=every_s)
+            seq += 1
 
     def step(self, step: int, data_wait_s: float, dispatch_s: float,
              fetch_s: float, batch_size: Optional[int] = None,

@@ -38,7 +38,7 @@ _DEVICE_PID_BASE = 100000
 #: event types rendered as instant markers (everything with a `t` that
 #: marks a moment rather than an interval and is worth seeing on a track)
 _INSTANT_EVENTS = ("stall", "anomaly", "compile", "checkpoint",
-                   "flightrec", "preempt", "resume", "error")
+                   "flightrec", "preempt", "resume", "error", "heartbeat")
 
 #: span names that root a unit of work, for the coverage summary
 ROOT_NAMES = ("step", "request")
@@ -75,37 +75,45 @@ def span_coverage(spans: Sequence[Dict[str, Any]],
             "mean": round(sum(fracs) / len(fracs), 4)}
 
 
-def _span_events(spans: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """Host spans -> Chrome "X" events, one tid per producing thread."""
+def _span_events(spans: Sequence[Dict[str, Any]], pid: int = HOST_PID,
+                 process_name: str = "host spans",
+                 shift_s: float = 0.0) -> List[Dict[str, Any]]:
+    """Host spans -> Chrome "X" events, one tid per producing thread.
+
+    ``pid``/``process_name``/``shift_s`` let obs/fleet.py render one
+    process-group per host on a shared aligned clock; the single-run
+    timeline uses the defaults.
+    """
     tids: Dict[str, int] = {}
     out: List[Dict[str, Any]] = [
-        {"ph": "M", "pid": HOST_PID, "name": "process_name",
-         "args": {"name": "host spans"}}]
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": process_name}}]
     for s in spans:
         thread = s.get("thread", "main")
         if thread not in tids:
             tids[thread] = len(tids) + 1
-            out.append({"ph": "M", "pid": HOST_PID, "tid": tids[thread],
+            out.append({"ph": "M", "pid": pid, "tid": tids[thread],
                         "name": "thread_name", "args": {"name": thread}})
         args = {k: v for k, v in s.items()
                 if k not in ("schema", "ts", "t", "event", "name",
                              "start_s", "dur_s", "thread")}
         out.append({
-            "ph": "X", "pid": HOST_PID, "tid": tids[thread],
+            "ph": "X", "pid": pid, "tid": tids[thread],
             "name": s.get("name", "?"),
-            "ts": round(float(s.get("start_s", 0.0)) * 1e6, 3),
+            "ts": round((float(s.get("start_s", 0.0)) + shift_s) * 1e6, 3),
             "dur": round(float(s.get("dur_s", 0.0)) * 1e6, 3),
             "args": args,
         })
     return out
 
 
-def _instant_events(records: Sequence[Dict[str, Any]]
-                    ) -> List[Dict[str, Any]]:
+def _instant_events(records: Sequence[Dict[str, Any]],
+                    pid: int = EVENTS_PID, process_name: str = "events",
+                    shift_s: float = 0.0) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = [
-        {"ph": "M", "pid": EVENTS_PID, "name": "process_name",
-         "args": {"name": "events"}},
-        {"ph": "M", "pid": EVENTS_PID, "tid": 1, "name": "thread_name",
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": process_name}},
+        {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
          "args": {"name": "markers"}}]
     n = 0
     for r in records:
@@ -115,9 +123,9 @@ def _instant_events(records: Sequence[Dict[str, Any]]
         args = {k: v for k, v in r.items()
                 if k not in ("schema", "ts", "t", "event")}
         out.append({
-            "ph": "i", "s": "g", "pid": EVENTS_PID, "tid": 1,
+            "ph": "i", "s": "g", "pid": pid, "tid": 1,
             "name": r["event"],
-            "ts": round(float(r["t"]) * 1e6, 3),
+            "ts": round((float(r["t"]) + shift_s) * 1e6, 3),
             "args": args,
         })
     return out if n else []

@@ -25,7 +25,11 @@ def check_span_integrity(records: Iterable[dict]) -> List[str]:
     ``parent_id`` must resolve to a flushed ``span_id`` (obs/trace.py's
     ``close()`` guarantees this by force-flushing open spans) and span ids
     must be unique — an orphan parent or a duplicate id means a writer
-    dropped or double-emitted part of a trace.
+    dropped or double-emitted part of a trace. The one exemption:
+    spans marked ``remote_parent`` inherited their parent across a
+    process boundary (a traceparent header / RAFT_TRACEPARENT envelope,
+    obs/fleet.py), so the parent legitimately lives in ANOTHER host's
+    file — ``cli fleet`` resolves those joins across the fleet dir.
     """
     spans = [r for r in records
              if isinstance(r, dict) and r.get("event") == "span"]
@@ -38,7 +42,8 @@ def check_span_integrity(records: Iterable[dict]) -> List[str]:
         seen.add(sid)
     for s in spans:
         parent = s.get("parent_id")
-        if parent is not None and parent not in seen:
+        if parent is not None and parent not in seen \
+                and not s.get("remote_parent"):
             errors.append(
                 f"span {s.get('span_id')!r} ({s.get('name')!r}): orphan "
                 f"parent_id {parent!r} — no such span in this file")
@@ -172,6 +177,81 @@ def check_numerics_integrity(records: Iterable[dict]) -> List[str]:
     return errors
 
 
+def check_fleet_integrity(records: Iterable[dict]) -> List[str]:
+    """Consistency of the schema-v10 fleet records (obs/fleet.py).
+
+    Host identity must be coherent or the offline clock alignment
+    attributes evidence to the wrong process: every stamped ``host_id``
+    non-empty and identical within a process segment (a ``run_start``
+    opens a new segment — an auto-resumed run legitimately appends a
+    second process's records, and pids differ, but two host identities
+    INSIDE one segment mean two writers share a log), ``heartbeat``
+    sequence numbers strictly increasing per (host, role) with a
+    non-decreasing ``t`` axis within a segment, and at most one
+    ``clock_anchor`` per host per segment — present whenever heartbeats
+    are (beats without an anchor cannot be placed on the fleet clock).
+    v1–v9 artifacts carry none of these records and no stamps, so they
+    lint clean (additive).
+    """
+    recs = [r for r in records if isinstance(r, dict)]
+    errors: List[str] = []
+    hosts: set = set()
+    anchors: dict = {}
+    last_seq: dict = {}
+    last_t: dict = {}
+    have_beats = False
+    have_anchor = False
+    for n, r in enumerate(recs):
+        if r.get("event") == "run_start":  # a new process segment begins
+            hosts, anchors = set(), {}
+            last_seq, last_t = {}, {}
+        if "host_id" in r:
+            hid = r.get("host_id")
+            if not isinstance(hid, str) or not hid:
+                errors.append(f"#{n} ({r.get('event')!r}): empty/"
+                              f"non-string host_id {hid!r}")
+            else:
+                hosts.add(hid)
+                if len(hosts) > 1:
+                    errors.append(
+                        f"#{n}: host_id inconsistent within one process "
+                        f"segment: {sorted(hosts)} (one segment = one "
+                        f"process)")
+                    hosts = {hid}
+        if r.get("event") == "clock_anchor":
+            have_anchor = True
+            hid = r.get("host_id")
+            anchors[hid] = anchors.get(hid, 0) + 1
+            if anchors[hid] > 1:
+                errors.append(f"#{n}: clock_anchor repeated for host "
+                              f"{hid!r} (must be present once per "
+                              f"segment)")
+        if r.get("event") == "heartbeat":
+            have_beats = True
+            key = (r.get("host_id"), r.get("role"))
+            seq = r.get("seq")
+            if not isinstance(seq, int) or seq < 0:
+                errors.append(f"heartbeat #{n}: seq must be a "
+                              f"non-negative int, got {seq!r}")
+                continue
+            if key in last_seq and seq <= last_seq[key]:
+                errors.append(
+                    f"heartbeat #{n} ({key[0]!r}/{key[1]!r}): seq {seq} "
+                    f"not after {last_seq[key]} — cadence not monotonic")
+            last_seq[key] = seq
+            t = r.get("t")
+            if isinstance(t, (int, float)):
+                if key in last_t and t < last_t[key]:
+                    errors.append(
+                        f"heartbeat #{n} ({key[0]!r}/{key[1]!r}): t {t} "
+                        f"rewound below {last_t[key]}")
+                last_t[key] = t
+    if have_beats and not have_anchor:
+        errors.append("heartbeat records present but no clock_anchor — "
+                      "beats cannot be placed on the fleet clock")
+    return errors
+
+
 def check_iter_policy(doc: dict) -> List[str]:
     """Schema + referential lint of one ``iter_policy.json`` document
     (obs/converge.py ``build_policy``) — the artifact the adaptive
@@ -301,6 +381,7 @@ def check_path(path: str) -> List[str]:
     errors.extend(check_span_integrity(records))
     errors.extend(check_converge_integrity(records))
     errors.extend(check_numerics_integrity(records))
+    errors.extend(check_fleet_integrity(records))
     return [f"{path}: {e}" for e in errors]
 
 

@@ -6,7 +6,11 @@ per connection, each blocking on its request's :class:`ResultHandle`
 while the scheduler batches across connections. Endpoints:
 
 * ``POST /v1/predict`` — body is an ``.npz`` with ``left``/``right``
-  HWC arrays; optional query args ``iters``, ``stream``, ``warm=1``.
+  HWC arrays; optional query args ``iters``, ``stream``, ``warm=1``. An
+  optional ``traceparent`` request header (obs/fleet.py's
+  ``00-<trace_id>-<span_id>-01`` shape) joins the server-side
+  queue_wait/collect_group/dispatch/retire spans under the client's
+  span — one trace across the process boundary — and is echoed back.
   200 → ``.npz`` with ``flow`` (H, W, 1) + request metadata headers;
   422 → the request retired as an error (poisoned input, etc.);
   503 → draining or queue-full backpressure. Per-request isolation means
@@ -37,6 +41,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from raft_stereo_tpu.obs.fleet import parse_traceparent
 from raft_stereo_tpu.serve.server import (ServerBusy, ServerDraining,
                                           StereoServer)
 
@@ -126,8 +131,16 @@ _PROM_OUTPUT_RANGE = (
 )
 
 
-def prometheus_metrics(stats: dict) -> str:
-    """Render a ``stats()`` dict as Prometheus text exposition format."""
+def prometheus_metrics(stats: dict, host_id: Optional[str] = None) -> str:
+    """Render a ``stats()`` dict as Prometheus text exposition format.
+
+    ``host_id`` (``cli serve`` passes the telemetry's) adds a ``host``
+    label to every sample — alongside the existing ``bucket`` label on
+    the per-bucket families — so a future multi-replica scrape
+    aggregates cleanly; None keeps the unlabeled single-process shape.
+    """
+    hl = f'host="{host_id}"' if host_id else ""
+    plain = "{" + hl + "}" if hl else ""
     lines = []
     for key, name, kind, help_text in _PROM_METRICS:
         if key not in stats:
@@ -137,40 +150,22 @@ def prometheus_metrics(stats: dict) -> str:
             value = int(value)
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
-        lines.append(f"{name} {float(value):g}")
-    quality = stats.get("quality") or {}
-    if quality:
-        for key, name, help_text in _PROM_QUALITY:
+        lines.append(f"{name}{plain} {float(value):g}")
+    for stats_key, families in (("quality", _PROM_QUALITY),
+                                ("iters", _PROM_ITERS),
+                                ("output_range", _PROM_OUTPUT_RANGE)):
+        per_bucket = stats.get(stats_key) or {}
+        if not per_bucket:
+            continue
+        for key, name, help_text in families:
             lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} gauge")
-            for bucket in sorted(quality):
-                value = quality[bucket].get(key)
+            for bucket in sorted(per_bucket):
+                value = per_bucket[bucket].get(key)
                 if value is None:
                     continue
-                lines.append(f'{name}{{bucket="{bucket}"}} '
-                             f"{float(value):g}")
-    iters = stats.get("iters") or {}
-    if iters:
-        for key, name, help_text in _PROM_ITERS:
-            lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} gauge")
-            for bucket in sorted(iters):
-                value = iters[bucket].get(key)
-                if value is None:
-                    continue
-                lines.append(f'{name}{{bucket="{bucket}"}} '
-                             f"{float(value):g}")
-    ranges = stats.get("output_range") or {}
-    if ranges:
-        for key, name, help_text in _PROM_OUTPUT_RANGE:
-            lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} gauge")
-            for bucket in sorted(ranges):
-                value = ranges[bucket].get(key)
-                if value is None:
-                    continue
-                lines.append(f'{name}{{bucket="{bucket}"}} '
-                             f"{float(value):g}")
+                labels = f'bucket="{bucket}"' + (f",{hl}" if hl else "")
+                lines.append(f"{name}{{{labels}}} {float(value):g}")
     return "\n".join(lines) + "\n"
 
 
@@ -180,6 +175,8 @@ class _Handler(BaseHTTPRequestHandler):
     stereo: StereoServer = None  # type: ignore[assignment]
     #: /metrics exposition toggle (make_http_server(metrics=...))
     metrics: bool = True
+    #: host label on /metrics samples (make_http_server(host_id=...))
+    host_id: Optional[str] = None
 
     def log_message(self, fmt, *args):  # route to logging, not stderr
         logger.debug("http: " + fmt, *args)
@@ -203,8 +200,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/slo":
             self._reply(200, _json_bytes(self.stereo.stats()))
         elif path == "/metrics" and self.metrics:
-            self._reply(200, prometheus_metrics(self.stereo.stats()).encode(),
-                        ctype="text/plain; version=0.0.4; charset=utf-8")
+            self._reply(200, prometheus_metrics(
+                self.stereo.stats(), host_id=self.host_id).encode(),
+                ctype="text/plain; version=0.0.4; charset=utf-8")
         else:
             self._reply(404, _json_bytes({"error": "not found"}))
 
@@ -222,13 +220,18 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": f"bad request body: {exc}"}))
             return
         q = parse_qs(url.query)
+        # cross-process trace join: a traceparent header parents the
+        # server-side span tree under the client's span (malformed
+        # headers degrade to "no remote parent", never an error)
+        traceparent = self.headers.get("traceparent")
+        parent = parse_traceparent(traceparent)
         try:
             handle = self.stereo.submit(
                 left, right,
                 iters=int(q["iters"][0]) if "iters" in q else None,
                 stream=q["stream"][0] if "stream" in q else None,
                 warm_start=q.get("warm", ["0"])[0] == "1",
-                timeout=5.0)
+                timeout=5.0, parent=parent)
         except ServerDraining:
             self._reply(503, _json_bytes({"error": "draining"}),
                         headers={"Retry-After": "never"})
@@ -245,6 +248,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "X-Latency-Ms": round(result.latency_s * 1e3, 3),
                 "X-Batch-Size": result.batch_size,
                 "X-Bucket": result.bucket}
+        if parent is not None:
+            meta["traceparent"] = traceparent
         if not result.ok:
             self._reply(422, _json_bytes(
                 {"error": result.error, "kind": result.error_kind,
@@ -257,11 +262,12 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_http_server(stereo: StereoServer, host: str = "127.0.0.1",
-                     port: int = 8600,
-                     metrics: bool = True) -> ThreadingHTTPServer:
+                     port: int = 8600, metrics: bool = True,
+                     host_id: Optional[str] = None) -> ThreadingHTTPServer:
     """Bind (but do not serve) the HTTP front; caller owns serve/shutdown."""
     handler = type("BoundHandler", (_Handler,),
-                   {"stereo": stereo, "metrics": metrics})
+                   {"stereo": stereo, "metrics": metrics,
+                    "host_id": host_id})
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.daemon_threads = True
     return httpd

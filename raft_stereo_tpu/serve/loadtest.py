@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_stereo_tpu.obs.trace import NULL_TRACER
 from raft_stereo_tpu.serve.server import (ServerBusy, ServerDraining,
                                           StereoServer)
 
@@ -126,6 +127,12 @@ def run_clients(server: StereoServer, lt: LoadTestConfig,
                         f"rejected={tally['rejected']} {note}")
             print(line, flush=True)
 
+    # client-side spans: each request opens a client_request span whose
+    # context rides submit(parent=...), so the server's queue_wait/
+    # collect_group/dispatch/retire spans join the client's trace — the
+    # in-process twin of the HTTP front's traceparent header
+    tracer = getattr(telemetry, "tracer", None) or NULL_TRACER
+
     def client(idx: int, specs: List[Dict]) -> None:
         rng = np.random.default_rng(lt.seed + 1000 + idx)
         for spec in specs:
@@ -133,19 +140,27 @@ def run_clients(server: StereoServer, lt: LoadTestConfig,
                                      poison=spec["poison"])
             with lock:
                 tally["submitted"] += 1
+            span = tracer.start("client_request", client=idx,
+                                ordinal=spec["ordinal"]) \
+                if tracer.enabled else None
             try:
                 handle = server.submit(
                     left, right, iters=lt.iters, stream=spec["stream"],
                     warm_start=spec["video"],
-                    timeout=lt.submit_timeout_s)
+                    timeout=lt.submit_timeout_s,
+                    parent=span.context if span is not None else None)
             except ServerDraining:
                 with lock:
                     tally["rejected"] += 1
+                if span is not None:
+                    span.set(status="rejected").end()
                 progress(f"client{idx} draining")
                 break  # admission closed: the rest of this client's trace
             except ServerBusy:
                 with lock:
                     tally["rejected"] += 1
+                if span is not None:
+                    span.set(status="rejected").end()
                 progress(f"client{idx} busy")
                 continue
             try:
@@ -153,8 +168,13 @@ def run_clients(server: StereoServer, lt: LoadTestConfig,
             except TimeoutError:
                 with lock:
                     tally["lost"] += 1  # admitted but never retired
+                if span is not None:
+                    span.set(status="lost").end()
                 progress(f"client{idx} LOST {handle.request_id}")
                 continue
+            if span is not None:
+                span.set(status="ok" if result.ok else "error",
+                         request_id=result.request_id).end()
             with lock:
                 done_count[0] += 1
                 if result.ok:

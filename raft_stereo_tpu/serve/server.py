@@ -192,6 +192,9 @@ class _Request:
     stream: Optional[str]
     t_submit: float
     handle: ResultHandle
+    # remote trace context (obs/fleet.py traceparent propagation): the
+    # client-side span this request's span tree parents under
+    parent: Optional[Any] = None
     t_dispatch: float = 0.0
     # lifecycle stamps for the request's span tree (queue_wait ends when
     # the scheduler pulls the request; dispatch ends when the device call
@@ -272,8 +275,13 @@ class StereoServer:
     def submit(self, left: np.ndarray, right: np.ndarray, *,
                iters: Optional[int] = None, stream: Optional[str] = None,
                warm_start: bool = False,
-               timeout: Optional[float] = None) -> ResultHandle:
+               timeout: Optional[float] = None,
+               parent=None) -> ResultHandle:
         """Admit one HWC stereo pair; returns the request's future.
+
+        ``parent`` is an optional span context (obs/trace.py
+        ``SpanContext``, possibly parsed from a traceparent header) the
+        request's span tree joins under — the cross-process trace story.
 
         Raises :class:`ServerDraining` once a drain started and
         :class:`ServerBusy` when the bounded queue stays full past
@@ -295,7 +303,7 @@ class StereoServer:
             else self.serve.default_iters,
             warm=bool(warm_start and stream is not None),
             stream=stream, t_submit=time.perf_counter(),
-            handle=ResultHandle(f"r?"))
+            handle=ResultHandle(f"r?"), parent=parent)
         req.handle.request_id = req.id
         try:
             admitted = self._queue.put(req, timeout=timeout)
@@ -569,10 +577,18 @@ class StereoServer:
             tc = req.t_collect or req.t_dispatch or end
             td = req.t_dispatch or tc
             te = req.t_disp_end or td
+            # a remote parent came across a process boundary, so its span
+            # lives in the CLIENT's log: remote_parent exempts the root
+            # from the in-file orphan lint (obs/validate.py); `cli fleet`
+            # resolves the join across the fleet dir
+            remote = {"remote_parent": True} if req.parent is not None \
+                else {}
             root = tracer.record(
                 "request", req.t_submit, end, id=req.id,
+                parent=req.parent,
                 status="ok" if result.ok else "error",
-                bucket=result.bucket, batch_size=result.batch_size)
+                bucket=result.bucket, batch_size=result.batch_size,
+                **remote)
             tracer.record("queue_wait", req.t_submit, tc, parent=root)
             tracer.record("collect_group", tc, td, parent=root)
             tracer.record("dispatch", td, te, parent=root)

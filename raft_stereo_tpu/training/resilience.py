@@ -576,3 +576,21 @@ def injected_nan_step() -> Optional[int]:
     except ValueError:
         logger.warning("ignoring unparseable %s=%r", FAULT_NAN_STEP_ENV, val)
         return None
+
+
+#: environment variable scripts/fleet_drill.py sets on ONE trainer of a
+#: multi-host drill: sleep this many seconds inside every step's dispatch
+#: leg, turning that host into a deterministic straggler the fleet rollup
+#: (obs/fleet.py) must name via the STRAGGLER verdict.
+FAULT_SLEEP_ENV = "RAFT_FAULT_SLEEP_S"
+
+
+def injected_sleep_s() -> Optional[float]:
+    val = os.environ.get(FAULT_SLEEP_ENV)
+    if not val:
+        return None
+    try:
+        return float(val)
+    except ValueError:
+        logger.warning("ignoring unparseable %s=%r", FAULT_SLEEP_ENV, val)
+        return None

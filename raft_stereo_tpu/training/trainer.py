@@ -200,7 +200,8 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
 
     run_dir = os.path.join(cfg.run_dir, cfg.name)
     tel = Telemetry(run_dir, run_name=cfg.name,
-                    stall_deadline_s=cfg.stall_deadline_s)
+                    stall_deadline_s=cfg.stall_deadline_s,
+                    host_id=cfg.host_id, fleet=cfg.fleet)
     tel.run_start(config={"model": dataclasses.asdict(model_cfg),
                           "train": dataclasses.asdict(cfg)},
                   n_params=int(n_params), resumed_step=int(state.step),
@@ -220,6 +221,10 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
     policy = resilience.AnomalyPolicy(
         cfg.anomaly_max_skips if cfg.anomaly_guard else 0, telemetry=tel)
     nan_step = resilience.injected_nan_step()
+    fault_sleep_s = resilience.injected_sleep_s()
+    # fleet liveness: heartbeat records on cadence from a daemon thread
+    # (no-op when fleet stamping is off or the cadence is 0)
+    tel.start_heartbeat("trainer", cfg.heartbeat_every_s)
     # numerics observatory (obs/numerics.py): leaf names are recovered
     # once — same flatten order as the in-step per-leaf norm vector
     if cfg.numerics:
@@ -285,6 +290,11 @@ def train(model_cfg: RAFTStereoConfig, cfg: TrainConfig,
                     t0 = time.perf_counter()
                     batch = next(batches)
                     t1 = time.perf_counter()
+                    if fault_sleep_s is not None:
+                        # scripts/fleet_drill.py's straggler hook: stretch
+                        # this host's dispatch leg so the fleet rollup has
+                        # a deterministic STRAGGLER to attribute
+                        time.sleep(fault_sleep_s)
                     if nan_step is not None and global_step + 1 == nan_step:
                         # scripts/fault_drill.py's injection hook: prove the
                         # device guard survives a poisoned batch

@@ -19,7 +19,18 @@ the tracing events — ``span`` (obs/trace.py) and ``flightrec`` (the
 telemetry flight recorder). For v7 files the lint additionally checks
 span referential integrity (obs/validate.py ``check_span_integrity``):
 unique span_ids, parent_ids resolving within the file, non-empty
-trace_ids.
+trace_ids (``remote_parent: true`` spans are exempt from the in-file
+parent resolution — their parent lives in another host's log).
+
+v10 adds the fleet-observatory events — ``heartbeat`` liveness beats and
+the ``clock_anchor`` monotonic-to-wall mapping (obs/fleet.py) — plus host
+identity (``host_id``/``pid``/``coords``) riding every record as optional
+extras. For files carrying them the lint additionally checks fleet
+referential integrity (obs/validate.py ``check_fleet_integrity``):
+non-empty host_ids consistent within a run segment, at most one
+clock_anchor per host per segment, heartbeat ``seq`` strictly increasing
+per (host, role) with a non-rewinding clock. All of v8 -> v10 stayed
+additive, so banked v1 -> v9 artifacts still lint clean.
 
 ``iter_policy.json`` artifacts (``cli converge --emit-policy``) are also
 accepted: any ``*.json`` path whose top-level ``kind`` is ``iter_policy``

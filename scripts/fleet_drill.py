@@ -1,0 +1,343 @@
+#!/usr/bin/env python
+"""Fleet drill: prove the multi-process observatory on real processes.
+
+The fleet observatory (obs/fleet.py) claims that N processes' event logs
+can be merged onto one aligned clock, that a propagated traceparent joins
+a client's span to the server's request lifecycle across the process
+boundary, and that ``cli doctor`` names a straggler and a dead host with
+correct attribution. This drill makes those claims a gate. It launches
+THREE real processes over the real CLI surfaces, on CPU, in-sandbox:
+
+* **host0** — ``cli serve`` on an ephemeral port; the drill driver (the
+  "client" host) opens a root span, exports it as the
+  ``RAFT_TRACEPARENT`` envelope to every child launch, and POSTs one
+  /v1/predict request under a ``traceparent`` header from a
+  client-side span — the server must echo the header and its request
+  span tree must join the client's trace.
+* **host1** — a ``cli train`` child with ``RAFT_FAULT_SLEEP_S`` injected:
+  every step's dispatch leg is stretched by a real sleep, making this
+  host a deterministic straggler the rollup must name.
+* **host2** — an identical trainer, SIGKILL'd mid-run: its heartbeats
+  stop with no ``run_end`` while the rest of the fleet runs on — the
+  DEAD_HOST signature.
+
+Assertions drive the real consumers: ``cli fleet <dir> --json`` must
+attribute STRAGGLER to host1 and DEAD_HOST to host2, report a cross-host
+trace join whose remote link parents the server's request under the
+client, and build one merged Perfetto timeline with a process-group per
+host; ``cli doctor <dir> --json`` must route to the same verdicts.
+
+Each run appends a JSON record to ``runs/fleet_drill/drills.jsonl``
+through the shared obs/ sink; exit status is non-zero on any failed
+assertion, so scripts/rehearse_round.py's ``fleet`` leg can gate a round
+on it.
+
+Run: python scripts/fleet_drill.py [--steps 6] [--sleep-s 1.0]
+     [--kill-step 3] [--keep-work]
+"""
+
+import argparse
+import io
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from fault_drill import (CHILD_TIMEOUT_S, H, W,  # noqa: E402
+                         make_sceneflow_tree, read_events_lenient,
+                         wait_for_step)
+from raft_stereo_tpu.obs.events import append_json_log  # noqa: E402
+from raft_stereo_tpu.obs.fleet import (TRACEPARENT_ENV,  # noqa: E402
+                                       format_traceparent)
+
+OUT = os.path.join(REPO, "runs", "fleet_drill")
+LOG = os.path.join(OUT, "drills.jsonl")
+
+HEARTBEAT_S = 0.5
+REQ_H, REQ_W = 48, 96  # one aligned /32 request shape for the POST
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def train_cmd(work, fleet, name, steps):
+    return [sys.executable, "-m", "raft_stereo_tpu.cli", "train",
+            "--name", name,
+            "--data_root", os.path.join(work, "data"),
+            "--ckpt_dir", os.path.join(work, "ckpts", name),
+            "--run_dir", fleet,
+            "--batch_size", "2", "--num_steps", str(steps),
+            "--image_size", str(H), str(W),
+            "--train_iters", "1", "--valid_iters", "1",
+            "--hidden_dims", "32", "32", "32",
+            "--validation_frequency", "1000000",
+            "--checkpoint_frequency", "1000000",
+            "--num_workers", "2", "--lr", "1e-4",
+            "--data_parallel", "1", "--stall_deadline_s", "0",
+            "--host_id", name, "--heartbeat_every", str(HEARTBEAT_S)]
+
+
+def serve_cmd(fleet, port):
+    return [sys.executable, "-m", "raft_stereo_tpu.cli", "serve",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--run_dir", os.path.join(fleet, "host0"),
+            "--hidden_dims", "32", "32", "32",
+            "--iters", "1", "--max_batch", "2",
+            "--host_id", "host0", "--heartbeat_every", str(HEARTBEAT_S)]
+
+
+def launch(cmd, work, leg, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
+    env.pop("XLA_FLAGS", None)  # 1-device children (pure speed)
+    env.update(env_extra or {})
+    log_path = os.path.join(work, f"{leg}.log")
+    log = open(log_path, "w")
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=log,
+                            stderr=subprocess.STDOUT, env=env)
+    return proc, log_path
+
+
+def wait_http_ready(port, proc, timeout_s=CHILD_TIMEOUT_S):
+    t0 = time.monotonic()
+    url = f"http://127.0.0.1:{port}/healthz"
+    while time.monotonic() - t0 < timeout_s:
+        if proc.poll() is not None:
+            raise RuntimeError(f"serve child exited rc={proc.returncode} "
+                               "before becoming ready")
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError(f"serve not ready on :{port} within {timeout_s:.0f}s")
+
+
+def post_predict(port, header):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    left = rng.integers(0, 255, (REQ_H, REQ_W, 3)).astype(np.float32)
+    right = rng.integers(0, 255, (REQ_H, REQ_W, 3)).astype(np.float32)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, left=left, right=right)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/predict", data=buf.getvalue(),
+        method="POST", headers={"traceparent": header})
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        return resp.status, resp.headers.get("traceparent")
+
+
+def run_drill(args, work):
+    """The 3-process drill body; returns (ok, detail)."""
+    from raft_stereo_tpu.obs import Telemetry
+    from raft_stereo_tpu.obs.trace import Tracer
+
+    fleet_dir = os.path.join(work, "fleet")
+    detail = {"steps": args.steps, "sleep_s": args.sleep_s,
+              "kill_step": args.kill_step}
+    port = free_port()
+    detail["port"] = port
+
+    # the client host: its root span is the cross-process trace the
+    # children join — exported to every launch via the env envelope
+    tel = Telemetry(os.path.join(fleet_dir, "client"), host_id="client")
+    Tracer(tel)
+    tel.run_start(config={"mode": "fleet-drill-client", "port": port})
+    root = tel.tracer.start("fleet_drill", port=port)
+    envelope = {TRACEPARENT_ENV: format_traceparent(root.context)}
+
+    procs = {}
+    try:
+        procs["host0"], log0 = launch(serve_cmd(fleet_dir, port), work,
+                                      "host0", env_extra=envelope)
+        procs["host1"], log1 = launch(
+            train_cmd(work, fleet_dir, "host1", args.steps), work, "host1",
+            env_extra=dict(envelope,
+                           RAFT_FAULT_SLEEP_S=str(args.sleep_s)))
+        procs["host2"], log2 = launch(
+            train_cmd(work, fleet_dir, "host2", args.steps), work, "host2",
+            env_extra=envelope)
+
+        # the cross-process request: client span -> traceparent header ->
+        # the server's request lifecycle spans
+        wait_http_ready(port, procs["host0"])
+        span = tel.tracer.start("client_request", shape=[REQ_H, REQ_W])
+        header = format_traceparent(span.context)
+        status, echoed = post_predict(port, header)
+        span.set(status="ok" if status == 200 else f"http {status}").end()
+        detail["request_status"] = status
+        detail["traceparent_echoed"] = echoed == header
+        if status != 200:
+            return False, dict(detail, error=f"predict HTTP {status}; "
+                                             f"see {log0}")
+        if echoed != header:
+            return False, dict(detail, error=f"traceparent not echoed: "
+                                             f"sent {header}, got {echoed}")
+
+        # the dead host: SIGKILL host2 once its event stream shows real
+        # steps (the step for s lands while s+1 runs — fault_drill timing)
+        seen = wait_for_step(
+            os.path.join(fleet_dir, "host2", "events.jsonl"),
+            max(args.kill_step - 1, 1), procs["host2"])
+        if seen is None:
+            return False, dict(detail, error="host2 exited before the "
+                                             f"kill step; see {log2}")
+        procs["host2"].send_signal(signal.SIGKILL)
+        rc2 = procs["host2"].wait(timeout=30)
+        detail["host2_rc"] = rc2
+        if rc2 == 0:
+            return False, dict(detail, error="SIGKILL'd host2 exited 0?!")
+
+        # the straggler must finish its full run (its slowness is the
+        # signal, not a failure) while host2's silence grows the gap
+        rc1 = procs["host1"].wait(timeout=CHILD_TIMEOUT_S)
+        detail["host1_rc"] = rc1
+        if rc1 != 0:
+            return False, dict(detail, error=f"straggler host1 rc={rc1}; "
+                                             f"see {log1}")
+
+        # graceful serve drain: SIGTERM -> run_end on host0's log
+        procs["host0"].send_signal(signal.SIGTERM)
+        rc0 = procs["host0"].wait(timeout=120)
+        detail["host0_rc"] = rc0
+        if rc0 != 0:
+            return False, dict(detail, error=f"serve drain rc={rc0}; "
+                                             f"see {log0}")
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        root.end()
+        tel.emit("run_end", steps=1, ok=True)
+        tel.close()
+
+    return check_consumers(fleet_dir, detail)
+
+
+def check_consumers(fleet_dir, detail):
+    """Drive the REAL consumers over the drill's logs and assert the
+    acceptance bar: attribution, trace join, merged timeline, doctor."""
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_stereo_tpu.cli", "fleet", fleet_dir,
+         "--json"], cwd=REPO, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        return False, dict(detail, error=f"cli fleet rc={r.returncode}: "
+                                         f"{r.stderr[-500:]}")
+    report = json.loads(r.stdout)
+    verdicts = {v["verdict"]: v for v in report["verdicts"]}
+    detail["verdicts"] = {v: verdicts[v].get("host") for v in verdicts}
+
+    straggler = verdicts.get("STRAGGLER")
+    if straggler is None or straggler.get("host") != "host1":
+        return False, dict(detail, error="STRAGGLER not attributed to "
+                                         f"host1: {report['verdicts']}")
+    dead = verdicts.get("DEAD_HOST")
+    if dead is None or dead.get("host") != "host2":
+        return False, dict(detail, error="DEAD_HOST not attributed to "
+                                         f"host2: {report['verdicts']}")
+    # evidence quotes both sides of each comparison
+    if "host1" not in straggler["evidence"][0] \
+            or "other hosts" not in straggler["evidence"][0]:
+        return False, dict(detail,
+                           error=f"thin STRAGGLER evidence: {straggler}")
+
+    # the cross-process trace: client's span parents the server's request
+    joins = [j for j in report["cross_host_traces"]
+             if "client" in j["hosts"] and "host0" in j["hosts"]]
+    remote = [l for j in joins for l in j["remote_links"]
+              if l["parent_host"] == "client"
+              and l["child_host"] == "host0"]
+    detail["cross_host_traces"] = len(report["cross_host_traces"])
+    detail["remote_links"] = remote
+    if not remote:
+        return False, dict(detail, error="no cross-host trace join with a "
+                                         "client-parented server span: "
+                                         f"{report['cross_host_traces']}")
+
+    # one merged timeline, one process-group per host, on one clock
+    tl = report["timeline"]
+    if tl["hosts"] != 4 or tl["spans"] <= 0:
+        return False, dict(detail, error=f"timeline not merged: {tl}")
+    if not os.path.exists(tl["path"]):
+        return False, dict(detail, error=f"timeline missing: {tl['path']}")
+    detail["timeline"] = {"hosts": tl["hosts"], "spans": tl["spans"],
+                          "markers": tl["markers"]}
+
+    # doctor routes a fleet dir to the same verdicts
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_stereo_tpu.cli", "doctor", fleet_dir,
+         "--json"], cwd=REPO, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        return False, dict(detail, error=f"cli doctor rc={r.returncode}")
+    doc = json.loads(r.stdout)
+    kinds = {v["verdict"] for v in doc["verdicts"]}
+    if not {"STRAGGLER", "DEAD_HOST"} <= kinds:
+        return False, dict(detail, error=f"doctor fleet verdicts: {kinds}")
+
+    # the dead host's truncated log is still read (lenient), and its
+    # heartbeat count is frozen where the SIGKILL landed
+    h2 = read_events_lenient(
+        os.path.join(fleet_dir, "host2", "events.jsonl"))
+    detail["host2_beats"] = sum(e.get("event") == "heartbeat" for e in h2)
+    if not any(e.get("event") == "clock_anchor" for e in h2):
+        return False, dict(detail, error="host2 log has no clock_anchor")
+    return True, detail
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="3-process fleet drill: straggler + SIGKILL'd host + "
+                    "cross-process trace join (see module doc)")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--sleep-s", type=float, default=8.0,
+                   help="injected per-step sleep on the straggler host — "
+                        "must dwarf the natural CPU step time (~2-3s on a "
+                        "contended runner) so the p95 ratio clears the "
+                        "2x STRAGGLER threshold with margin")
+    p.add_argument("--kill-step", type=int, default=3)
+    p.add_argument("--keep-work", action="store_true",
+                   help="keep the work dir (child run artifacts) on success")
+    args = p.parse_args(argv)
+
+    os.makedirs(OUT, exist_ok=True)
+    work = os.path.join(OUT, "work")
+    if os.path.exists(work):
+        shutil.rmtree(work)
+    os.makedirs(work)
+    make_sceneflow_tree(os.path.join(work, "data"))
+
+    t0 = time.monotonic()
+    try:
+        ok, detail = run_drill(args, work)
+    except Exception as e:
+        ok, detail = False, {"error": f"{type(e).__name__}: {e}"}
+    record = {"drill": "fleet", "ok": ok,
+              "wall_s": round(time.monotonic() - t0, 1), "detail": detail}
+    append_json_log(LOG, record, stream=sys.stderr)
+    if ok and not args.keep_work:
+        shutil.rmtree(work, ignore_errors=True)
+    print("fleet drill ok" if ok
+          else f"FLEET DRILL FAILED: {detail.get('error')}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,15 @@ rehearsal:
   fires the saturation counters (BF16_SATURATION), and a
   ``cli loadtest --numerics`` leaves per-dispatch ``numerics`` events
   plus the per-bucket output-range gauges.
+* **fleet** — the fleet-observatory rehearsal (r17): ``python
+  scripts/fleet_drill.py`` — a real 3-process CPU drill (one ``cli
+  serve`` host, one sleep-injected straggler trainer, one SIGKILL'd
+  trainer) whose merged ``cli fleet`` rollup must attribute STRAGGLER
+  to the slow host and DEAD_HOST to the killed one, join the client's
+  span to the server's request lifecycle across the process boundary
+  via the traceparent header, and build one clock-aligned Perfetto
+  timeline with a process-group per host; ``cli doctor`` over the
+  fleet dir must route to the same verdicts.
 
 Each leg appends a dated JSON record to ``runs/rehearsal.log`` through the
 shared obs/ sink; exit status is non-zero if any attempted leg failed, so
@@ -235,10 +244,12 @@ def main(argv=None):
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace", "converge", "numerics"],
+                            "serve", "trace", "converge", "numerics",
+                            "fleet"],
                    choices=["bench", "multichip", "events", "compare",
                             "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace", "converge", "numerics"])
+                            "serve", "trace", "converge", "numerics",
+                            "fleet"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
@@ -247,6 +258,7 @@ def main(argv=None):
     p.add_argument("--trace-budget", type=float, default=1800.0)
     p.add_argument("--converge-budget", type=float, default=1800.0)
     p.add_argument("--numerics-budget", type=float, default=1800.0)
+    p.add_argument("--fleet-budget", type=float, default=1800.0)
     p.add_argument("--bench-budget", type=float, default=BENCH_BUDGET_S)
     p.add_argument("--multichip-budget", type=float,
                    default=MULTICHIP_BUDGET_S)
@@ -327,6 +339,12 @@ def main(argv=None):
             [sys.executable, os.path.join(REPO, "scripts",
                                           "numerics_drill.py")],
             args.numerics_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "fleet" in args.legs:
+        records.append(run_leg(
+            "fleet",
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "fleet_drill.py")],
+            args.fleet_budget, env={"JAX_PLATFORMS": "cpu"}))
 
     ok = True
     for rec in records:

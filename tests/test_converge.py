@@ -356,11 +356,13 @@ def test_no_converge_is_zero_overhead(tmp_path, tiny, pred_off, pred_on):
     def scrub(events):
         # compile events depend on the process-level jit cache (the first
         # run pays for shared helpers), and the wall-clock/run-name fields
-        # differ by construction — the semantic stream must not
+        # differ by construction — the semantic stream must not (the v10
+        # clock_anchor is monotonic/wall by definition, so it goes too)
         return [{k: v for k, v in e.items()
                  if k not in ("t", "ts", "run", "path", "data_wait_s",
                               "dispatch_s", "fetch_s")}
-                for e in events if e.get("event") != "compile"]
+                for e in events
+                if e.get("event") not in ("compile", "clock_anchor")]
 
     assert scrub(ev1) == scrub(ev2)
     assert [e for e in ev1 if e.get("event") == "converge"] == []
@@ -549,7 +551,8 @@ def test_serve_no_converge_emits_nothing_extra(tmp_path):
                 "p50_ms", "p99_ms", "pairs_per_sec", "batch_size",
                 "in_flight", "depth")
         return [{k: v for k, v in e.items() if k not in drop}
-                for e in events if e.get("event") != "compile"]
+                for e in events
+                if e.get("event") not in ("compile", "clock_anchor")]
 
     assert scrub(a) == scrub(b)
 
@@ -621,7 +624,7 @@ def test_cli_drift_v7_fires_on_seeded_converge_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 7
+    assert RULE_VERSIONS["cli-drift"] == 8
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "obs").mkdir(parents=True)
     (pkg / "cli.py").write_text(

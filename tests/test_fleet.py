@@ -1,0 +1,476 @@
+"""Fleet observatory (obs/fleet.py) and its schema-v10 plumbing.
+
+What is pinned here, per the r17 acceptance bar:
+
+* the traceparent envelope round-trips and degrades to "no remote
+  parent" on anything malformed;
+* clock alignment reproduces a hand-built two-host fixture exactly —
+  anchored offsets from ``clock_anchor``, the ts-derived fallback for
+  pre-v10 logs;
+* the skew table and the STRAGGLER / DEAD_HOST / DESYNC verdicts fire on
+  seeded logs with correct host attribution, and a clean fleet reads
+  FLEET_OK (the negatives);
+* a cross-process trace join: one trace_id across two hosts' logs, the
+  client span parenting the server's request root, and the
+  ``remote_parent`` exemption in the span lint;
+* ``check_fleet_integrity`` catches inconsistent host identity,
+  duplicate anchors and heartbeat seq regressions — per run_start
+  segment, so auto-resume appends stay clean;
+* the Telemetry bus stamps host_id/pid on every record, anchors the
+  clock once, prefixes flight-recorder dumps with the host, and with
+  ``fleet=False`` the stream is byte-shaped like a pre-v10 single-process
+  run (the additive pin);
+* ``cli fleet`` / ``cli doctor`` consume a fleet dir end to end, and
+  cli-drift rule v8 covers the build_fleet_parser surface.
+"""
+
+import json
+import os
+
+import pytest
+
+from raft_stereo_tpu.obs import fleet
+from raft_stereo_tpu.obs.telemetry import Telemetry
+from raft_stereo_tpu.obs.trace import SpanContext, Tracer
+from raft_stereo_tpu.obs.validate import (check_fleet_integrity, check_path,
+                                          check_span_integrity)
+
+TS = "2026-08-07T00:00:00"
+
+
+def _rec(event, t, **payload):
+    """A hand-built v10 record with a controlled monotonic ``t``."""
+    return dict({"schema": 10, "ts": TS, "event": event,
+                 "t": round(float(t), 6)}, **payload)
+
+
+def _host_log(fleet_dir, name, records):
+    d = fleet_dir / name
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / "events.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return d
+
+
+def _step(t, step, dispatch_s, host_id, **extra):
+    return _rec("step", t, step=step, data_wait_s=0.001,
+                dispatch_s=dispatch_s, fetch_s=0.001, batch_size=2,
+                host_id=host_id, pid=1000, **extra)
+
+
+def _train_host(host_id, offset, n_steps, dispatch_s, run_end=True,
+                beats=(), every_s=0.5):
+    """A synthetic trainer log: run_start, anchor (wall = t + offset),
+    n_steps steps 1s apart, optional heartbeats and run_end."""
+    recs = [_rec("run_start", 0.0, run=host_id, host_id=host_id, pid=1000),
+            _rec("clock_anchor", 0.0, host_id=host_id, pid=1000,
+                 monotonic=0.0, wall=offset)]
+    for i in range(1, n_steps + 1):
+        recs.append(_step(float(i), i, dispatch_s, host_id))
+    for seq, t in enumerate(beats):
+        recs.append(_rec("heartbeat", t, host_id=host_id, pid=1000,
+                         role="trainer", seq=seq, every_s=every_s))
+    if run_end:
+        recs.append(_rec("run_end", n_steps + 1.0, steps=n_steps, ok=True,
+                         host_id=host_id, pid=1000))
+    return recs
+
+
+# ------------------------------------------------ traceparent / host id
+
+def test_traceparent_round_trip_and_malformed():
+    ctx = SpanContext(trace_id="t00abc", span_id="s00def")
+    header = fleet.format_traceparent(ctx)
+    assert header == "00-t00abc-s00def-01"
+    assert fleet.parse_traceparent(header) == ctx
+    for bad in (None, "", "garbage", "00-only-three", "00--s1-01", 7):
+        assert fleet.parse_traceparent(bad) is None
+
+
+def test_resolve_host_id_precedence(monkeypatch):
+    monkeypatch.setenv(fleet.HOST_ID_ENV, "from-env")
+    assert fleet.resolve_host_id("explicit") == "explicit"
+    assert fleet.resolve_host_id() == "from-env"
+    monkeypatch.delenv(fleet.HOST_ID_ENV)
+    default = fleet.resolve_host_id()
+    assert default.endswith(f"-{os.getpid()}")
+
+
+# --------------------------------------------------- clock alignment
+
+def test_clock_alignment_matches_hand_built_two_host_fixture(tmp_path):
+    """Anchored offsets place both hosts on one epoch axis: hostB's clock
+    starts 2.5s after hostA's, so its step at t=1 lands at aligned 1003.5
+    while hostA's lands at 1001.0 — and the fleet wall is the exact
+    hand-computed span, not either host's local extent."""
+    _host_log(tmp_path, "hostA", _train_host("hostA", 1000.0, 3, 0.01))
+    _host_log(tmp_path, "hostB", _train_host("hostB", 1002.5, 3, 0.01))
+    roll = fleet.aggregate_fleet(str(tmp_path))
+    by_id = {h["host_id"]: h for h in roll["hosts"]}
+    assert by_id["hostA"]["offset"] == 1000.0
+    assert by_id["hostB"]["offset"] == 1002.5
+    assert by_id["hostA"]["anchored"] and by_id["hostB"]["anchored"]
+    assert by_id["hostA"]["aligned_start"] == 1000.0   # run_start at t=0
+    assert by_id["hostB"]["aligned_end"] == 1006.5     # run_end at t=4
+    # fleet wall: earliest aligned record 1000.0 -> latest 1006.5
+    assert roll["wall_s"] == 6.5
+
+
+def test_unanchored_log_falls_back_to_ts_offset(tmp_path):
+    """A pre-v10 log (no clock_anchor, no host stamps) still lands on the
+    fleet axis via ts - t, and its host_id falls back to the dirname."""
+    recs = [{"schema": 9, "ts": "2026-08-07T00:00:10", "event": "run_start",
+             "t": 10.0, "run": "old"},
+            {"schema": 9, "ts": "2026-08-07T00:00:11", "event": "run_end",
+             "t": 11.0, "steps": 0, "ok": True}]
+    d = _host_log(tmp_path, "legacy", recs)
+    h = fleet.load_host(str(d))
+    assert not h["anchored"]
+    assert h["host_id"] == "legacy"
+    import datetime
+    expect = datetime.datetime.fromisoformat(
+        "2026-08-07T00:00:10").timestamp() - 10.0
+    assert h["offset"] == expect
+
+
+def test_lenient_reader_survives_sigkill_truncation(tmp_path):
+    d = _host_log(tmp_path, "killed", _train_host("killed", 0.0, 2, 0.01,
+                                                  run_end=False))
+    with open(d / "events.jsonl", "a") as f:
+        f.write('{"schema": 10, "ts": "2026-08-07T00:0')  # torn final line
+    recs = fleet.read_events_lenient(str(d / "events.jsonl"))
+    assert [r["event"] for r in recs] == ["run_start", "clock_anchor",
+                                         "step", "step"]
+
+
+# ------------------------------------------------------- fleet verdicts
+
+def test_straggler_verdict_names_the_slow_host(tmp_path):
+    for name, dispatch in (("h0", 0.01), ("h1", 0.01), ("h2", 0.25)):
+        _host_log(tmp_path, name, _train_host(name, 1000.0, 5, dispatch))
+    roll = fleet.aggregate_fleet(str(tmp_path))
+    row = next(r for r in roll["skew"] if r["host_id"] == "h2")
+    assert row["vs_others"] >= fleet.STRAGGLER_FACTOR
+    verdicts = fleet.fleet_verdicts(roll)
+    stragglers = [v for v in verdicts if v["verdict"] == "STRAGGLER"]
+    assert [v["host"] for v in stragglers] == ["h2"]
+    # evidence quotes both the host's and the fleet's numbers
+    assert "h2" in stragglers[0]["evidence"][0]
+    assert str(row["others_p95_ms"]) in stragglers[0]["evidence"][0]
+
+
+def test_clean_fleet_reads_fleet_ok(tmp_path):
+    for name in ("h0", "h1", "h2"):
+        _host_log(tmp_path, name, _train_host(
+            name, 1000.0, 5, 0.01, beats=(0.5, 1.0, 1.5, 2.0)))
+    verdicts = fleet.fleet_verdicts(fleet.aggregate_fleet(str(tmp_path)))
+    assert [v["verdict"] for v in verdicts] == ["FLEET_OK"]
+
+
+def test_dead_host_fires_on_heartbeat_gap_but_not_on_clean_exit(tmp_path):
+    # h0 runs the full 20s window with beats throughout; h1's beats stop
+    # at t=1.0 with no run_end — 19s of silence >> 3x the 0.5s cadence
+    long_beats = tuple(i * 0.5 for i in range(1, 41))
+    _host_log(tmp_path, "h0", _train_host("h0", 1000.0, 20, 0.01,
+                                          beats=long_beats))
+    _host_log(tmp_path, "h1", _train_host("h1", 1000.0, 2, 0.01,
+                                          run_end=False, beats=(0.5, 1.0)))
+    verdicts = fleet.fleet_verdicts(fleet.aggregate_fleet(str(tmp_path)))
+    dead = [v for v in verdicts if v["verdict"] == "DEAD_HOST"]
+    assert [v["host"] for v in dead] == ["h1"]
+    assert "h1" in dead[0]["evidence"][0]
+    # the same silent log WITH a run_end is an exit, not a death
+    _host_log(tmp_path, "h1", _train_host("h1", 1000.0, 2, 0.01,
+                                          run_end=True, beats=(0.5, 1.0)))
+    verdicts = fleet.fleet_verdicts(fleet.aggregate_fleet(str(tmp_path)))
+    assert not any(v["verdict"] == "DEAD_HOST" for v in verdicts)
+
+
+def test_desync_judged_over_live_hosts_only(tmp_path):
+    # both hosts live and beating, step counters 10 vs 3: DESYNC
+    beats = tuple(i * 0.5 for i in range(1, 23))
+    _host_log(tmp_path, "h0", _train_host("h0", 1000.0, 10, 0.01,
+                                          beats=beats))
+    _host_log(tmp_path, "h1", _train_host("h1", 1000.0, 3, 0.012,
+                                          beats=beats))
+    verdicts = fleet.fleet_verdicts(fleet.aggregate_fleet(str(tmp_path)))
+    desync = [v for v in verdicts if v["verdict"] == "DESYNC"]
+    assert len(desync) == 1 and desync[0]["host"] == "h1"
+    # a DEAD host's stale counter must not double-report as DESYNC
+    _host_log(tmp_path, "h1", _train_host("h1", 1000.0, 3, 0.012,
+                                          run_end=False, beats=(0.5, 1.0)))
+    verdicts = fleet.fleet_verdicts(fleet.aggregate_fleet(str(tmp_path)))
+    kinds = [v["verdict"] for v in verdicts]
+    assert "DEAD_HOST" in kinds and "DESYNC" not in kinds
+
+
+def test_serving_logs_are_excluded_from_straggler_stats(tmp_path):
+    """A serve host's ``step`` records are per-request accounting, not
+    train steps — they must not feed the skew table."""
+    recs = _train_host("srv", 1000.0, 5, 0.5, run_end=True)
+    recs.append(_rec("request", 2.0, id="r1", status="ok", host_id="srv",
+                     pid=1000))
+    _host_log(tmp_path, "srv", recs)
+    _host_log(tmp_path, "h0", _train_host("h0", 1000.0, 5, 0.01))
+    roll = fleet.aggregate_fleet(str(tmp_path))
+    assert [r["host_id"] for r in roll["skew"]] == ["h0"]
+
+
+# ------------------------------------------- cross-process trace joins
+
+def _span(t, name, span_id, trace_id, host_id, parent_id=None, **extra):
+    r = _rec("span", t, name=name, span_id=span_id, trace_id=trace_id,
+             start_s=t, dur_s=0.01, host_id=host_id, pid=1000, **extra)
+    if parent_id is not None:
+        r["parent_id"] = parent_id
+    return r
+
+
+def test_cross_process_trace_join_and_remote_parent_exemption(tmp_path):
+    """The propagated-context proof: the client's span and the server's
+    request root share one trace_id across two files, the root names the
+    client span as parent, and the span lint accepts the cross-file
+    parent only because the span is marked ``remote_parent``."""
+    client = _train_host("client", 1000.0, 3, 0.01)
+    client.append(_span(1.2, "client_request", "s00001", "t00001", "client"))
+    _host_log(tmp_path, "client", client)
+    server = _train_host("server", 1001.0, 3, 0.01)
+    server.append(_span(0.3, "request", "s00002", "t00001", "server",
+                        parent_id="s00001", remote_parent=True))
+    server.append(_span(0.3, "queue_wait", "s00003", "t00001", "server",
+                        parent_id="s00002"))
+    _host_log(tmp_path, "server", server)
+
+    roll = fleet.aggregate_fleet(str(tmp_path))
+    joins = roll["cross_host_traces"]
+    assert len(joins) == 1
+    j = joins[0]
+    assert j["trace_id"] == "t00001"
+    assert j["hosts"] == ["client", "server"] and j["spans"] == 3
+    assert j["remote_links"] == [{"child": "request",
+                                  "child_host": "server",
+                                  "parent_host": "client"}]
+
+    # lint: the marked span's unresolvable parent is exempt ...
+    srv_recs = fleet.read_events_lenient(
+        str(tmp_path / "server" / "events.jsonl"))
+    assert check_span_integrity(srv_recs) == []
+    # ... and without the mark the same shape is still an orphan error
+    for r in srv_recs:
+        r.pop("remote_parent", None)
+    assert any("parent" in e for e in check_span_integrity(srv_recs))
+
+
+# ------------------------------------------------------ schema-v10 lint
+
+def test_fleet_integrity_positives_and_negatives():
+    clean = _train_host("h0", 1000.0, 2, 0.01, beats=(0.5, 1.0))
+    assert check_fleet_integrity(clean) == []
+    # inconsistent host identity within one segment
+    bad = [dict(r) for r in clean]
+    bad[2]["host_id"] = "imposter"
+    assert any("host_id" in e for e in check_fleet_integrity(bad))
+    # a second clock_anchor in the same segment
+    bad = clean + [_rec("clock_anchor", 1.5, host_id="h0", pid=1000,
+                        monotonic=1.5, wall=1001.5)]
+    assert any("clock_anchor" in e for e in check_fleet_integrity(bad))
+    # heartbeat seq must be strictly increasing per (host, role)
+    bad = clean + [_rec("heartbeat", 1.5, host_id="h0", pid=1000,
+                        role="trainer", seq=0, every_s=0.5)]
+    assert any("seq" in e for e in check_fleet_integrity(bad))
+    # heartbeats with no clock_anchor cannot be aligned offline
+    noanchor = [r for r in clean if r["event"] != "clock_anchor"]
+    assert any("clock_anchor" in e
+               for e in check_fleet_integrity(noanchor))
+
+
+def test_fleet_integrity_resets_per_run_start_segment():
+    """Auto-resume appends a second process's records — new host_id, its
+    own anchor, fresh heartbeat seqs — to the SAME file; each run_start
+    opens a new segment, so the combined file lints clean."""
+    first = _train_host("h0-pid1", 1000.0, 2, 0.01, beats=(0.5, 1.0))
+    resumed = _train_host("h0-pid2", 1030.0, 2, 0.01, beats=(0.5, 1.0))
+    assert check_fleet_integrity(first + resumed) == []
+
+
+# --------------------------------------------- Telemetry bus stamping
+
+def test_telemetry_stamps_host_identity_and_anchors_once(tmp_path):
+    tel = Telemetry(str(tmp_path / "run"), host_id="stamp-host",
+                    coords=(0, 1))
+    tel.run_start(config={"mode": "test"})
+    tel.step(1, data_wait_s=0.0, dispatch_s=0.01, fetch_s=0.0,
+             batch_size=2)
+    tel.emit("run_end", steps=1, ok=True)
+    tel.close()
+    recs = fleet.read_events_lenient(str(tmp_path / "run" / "events.jsonl"))
+    assert all(r["host_id"] == "stamp-host" for r in recs)
+    assert all(r["pid"] == os.getpid() for r in recs)
+    assert all(r["coords"] == [0, 1] for r in recs)
+    anchors = [r for r in recs if r["event"] == "clock_anchor"]
+    assert len(anchors) == 1
+    assert check_path(str(tmp_path / "run" / "events.jsonl")) == []
+
+
+def test_traceparent_envelope_rides_run_start(tmp_path, monkeypatch):
+    monkeypatch.setenv(fleet.TRACEPARENT_ENV, "00-t00abc-s00def-01")
+    tel = Telemetry(str(tmp_path / "run"), host_id="child")
+    tel.run_start(config={})
+    tel.close()
+    recs = fleet.read_events_lenient(str(tmp_path / "run" / "events.jsonl"))
+    start = next(r for r in recs if r["event"] == "run_start")
+    assert start["traceparent"] == "00-t00abc-s00def-01"
+
+
+def test_flight_dump_filenames_carry_the_host(tmp_path):
+    tel = Telemetry(str(tmp_path / "run"), host_id="dump/host",
+                    flightrec_min_interval_s=0.0)
+    tel.run_start(config={})
+    tel.emit("anomaly", kind="test_trigger")
+    tel.close()
+    dumps = [f for f in os.listdir(tmp_path / "run")
+             if f.startswith("flightrec-")]
+    # the host tag is sanitized into the filename — two hosts sharing a
+    # run dir can no longer clobber each other's dumps
+    assert dumps and all(f.startswith("flightrec-dump_host-")
+                         for f in dumps)
+    recs = fleet.read_events_lenient(str(tmp_path / "run" / "events.jsonl"))
+    pointer = next(r for r in recs if r["event"] == "flightrec")
+    assert pointer["host_id"] == "dump/host"
+
+
+def test_no_fleet_stream_is_bitwise_plain(tmp_path):
+    """The additive pin: fleet=False must leave the stream byte-shaped
+    like a pre-v10 run — drop the stamps and the v10 records from a
+    fleet=True stream and the two are identical (modulo clocks)."""
+    def run(dirname, fleet_on):
+        tel = Telemetry(str(tmp_path / dirname), run_name="pin",
+                        host_id="pin-host" if fleet_on else None,
+                        fleet=fleet_on)
+        tel.run_start(config={"mode": "pin"})
+        for i in range(3):
+            tel.step(i, data_wait_s=0.01, dispatch_s=0.02, fetch_s=0.005,
+                     batch_size=2, loss=1.5)
+        tel.emit("run_end", steps=3, ok=True)
+        tel.close()
+        return fleet.read_events_lenient(
+            str(tmp_path / dirname / "events.jsonl"))
+
+    plain = run("plain", fleet_on=False)
+    stamped = run("stamped", fleet_on=True)
+    assert not any("host_id" in r or "pid" in r for r in plain)
+    assert not any(r["event"] in ("clock_anchor", "heartbeat")
+                   for r in plain)
+
+    def scrub(events):
+        return [{k: v for k, v in e.items()
+                 if k not in ("t", "ts", "host_id", "pid")}
+                for e in events
+                if e["event"] not in ("clock_anchor", "heartbeat")]
+
+    assert scrub(stamped) == scrub(plain)
+
+
+def test_heartbeat_thread_beats_with_increasing_seq(tmp_path):
+    tel = Telemetry(str(tmp_path / "run"), host_id="beater")
+    assert tel.start_heartbeat("trainer", 0.0) is None   # cadence off
+    tel.run_start(config={})
+    t = tel.start_heartbeat("trainer", 0.02,
+                            probe=lambda: {"step_now": 7})
+    assert t is not None
+    import time
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        recs = fleet.read_events_lenient(
+            str(tmp_path / "run" / "events.jsonl"))
+        if sum(r["event"] == "heartbeat" for r in recs) >= 3:
+            break
+        time.sleep(0.02)
+    tel.close()
+    recs = fleet.read_events_lenient(str(tmp_path / "run" / "events.jsonl"))
+    beats = [r for r in recs if r["event"] == "heartbeat"]
+    assert len(beats) >= 3
+    assert [b["seq"] for b in beats] == list(range(len(beats)))
+    assert all(b["role"] == "trainer" and b["every_s"] == 0.02
+               and b["step_now"] == 7 for b in beats)
+    assert check_fleet_integrity(recs) == []
+    # fleet off: no thread, ever
+    off = Telemetry(str(tmp_path / "off"), fleet=False)
+    assert off.host_id is None
+    assert off.start_heartbeat("trainer", 0.02) is None
+    off.close()
+
+
+# ------------------------------------------------- timeline + consumers
+
+def test_fleet_timeline_one_process_group_per_host(tmp_path):
+    client = _train_host("client", 1000.0, 2, 0.01, beats=(0.5, 1.0))
+    client.append(_span(1.2, "client_request", "s00001", "t00001",
+                        "client"))
+    _host_log(tmp_path, "client", client)
+    server = _train_host("server", 1002.0, 2, 0.01, beats=(0.5, 1.0))
+    server.append(_span(0.3, "request", "s00002", "t00001", "server",
+                        parent_id="s00001", remote_parent=True))
+    _host_log(tmp_path, "server", server)
+    info = fleet.build_fleet_timeline(str(tmp_path))
+    assert info["hosts"] == 2 and info["spans"] == 2
+    assert info["markers"] >= 2          # the heartbeats render as markers
+    doc = json.load(open(info["path"]))
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert {"client spans", "client events",
+            "server spans", "server events"} <= names
+    # both spans on ONE aligned clock: the server span (local t=0.3,
+    # offset 1002) must land AFTER the client span (t=1.2, offset 1000)
+    by_name = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+    assert by_name["request"]["ts"] > by_name["client_request"]["ts"]
+
+
+def test_cli_fleet_writes_rollup_and_doctor_routes(tmp_path, capsys):
+    from raft_stereo_tpu.obs import doctor
+    for name, dispatch in (("h0", 0.01), ("h1", 0.25)):
+        _host_log(tmp_path / "fleet", name,
+                  _train_host(name, 1000.0, 5, dispatch))
+    assert fleet.main([str(tmp_path / "fleet"), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["n_hosts"] == 2
+    assert any(v["verdict"] == "STRAGGLER" and v["host"] == "h1"
+               for v in report["verdicts"])
+    assert os.path.exists(tmp_path / "fleet" / "fleet_rollup.json")
+    assert os.path.exists(tmp_path / "fleet" / "fleet_timeline.json")
+    # doctor pointed at the fleet dir routes to the fleet verdicts
+    diag = doctor.diagnose(str(tmp_path / "fleet"))
+    assert any(v["verdict"] == "STRAGGLER" for v in diag["verdicts"])
+    # an empty dir is a loud exit 1, not a stack trace
+    (tmp_path / "empty").mkdir()
+    assert fleet.main([str(tmp_path / "empty")]) == 1
+
+
+def test_cli_drift_v8_fires_on_seeded_fleet_fixture(tmp_path):
+    """Rule v8: an orphan flag on the fleet surface is an error — the
+    fixture seeds an unconsumed flag next to consumed ones; flags the
+    obs/fleet.py consumer reads stay clean."""
+    from raft_stereo_tpu.analysis.ast_rules import (
+        RULE_VERSIONS, check_entry_surface_drift)
+
+    assert RULE_VERSIONS["cli-drift"] == 8
+    pkg = tmp_path / "raft_stereo_tpu"
+    (pkg / "obs").mkdir(parents=True)
+    (pkg / "cli.py").write_text(
+        "def build_fleet_parser():\n"
+        "    import argparse\n"
+        "    p = argparse.ArgumentParser()\n"
+        "    p.add_argument('fleet_dir')\n"
+        "    p.add_argument('--out')\n"
+        "    p.add_argument('--fleet_orphan')\n"
+        "    return p\n")
+    (pkg / "obs" / "fleet.py").write_text(
+        "def main(args):\n"
+        "    return (args.fleet_dir, args.out)\n")
+    findings = check_entry_surface_drift(str(tmp_path))
+    errors = [f for f in findings
+              if f.rule == "cli-drift" and f.severity == "error"]
+    assert {f.data.get("dest") for f in errors} == {"fleet_orphan"}
+    assert {f.data.get("surface") for f in errors} == {"build_fleet_parser"}

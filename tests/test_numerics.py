@@ -380,10 +380,13 @@ def test_no_numerics_double_run_is_byte_identical(tmp_path, pred_off):
     ev2 = _eval_run(tmp_path, "off2", ds, pred_off, stream=False)
 
     def scrub(events):
+        # the v10 clock_anchor is monotonic/wall by definition — drop it
+        # like the other wall-clock fields
         return [{k: v for k, v in e.items()
                  if k not in ("t", "ts", "run", "path", "data_wait_s",
                               "dispatch_s", "fetch_s")}
-                for e in events if e.get("event") != "compile"]
+                for e in events
+                if e.get("event") not in ("compile", "clock_anchor")]
 
     assert scrub(ev1) == scrub(ev2)
     assert [e for e in ev1 if e.get("event") == "numerics"] == []
@@ -622,7 +625,7 @@ def test_cli_drift_v6_fires_on_seeded_numerics_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 7
+    assert RULE_VERSIONS["cli-drift"] == 8
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "obs").mkdir(parents=True)
     (pkg / "cli.py").write_text(

@@ -362,7 +362,10 @@ def test_http_metrics_exposition_after_load(tmp_path):
         autostart=False)
     server.cache = _FakeCache()
     server.start()
-    httpd = make_http_server(server, "127.0.0.1", 0)   # ephemeral port
+    # host_id exercises the schema-v10 fleet labeling: every family gains
+    # a host label so N hosts' expositions can be scraped into one view
+    httpd = make_http_server(server, "127.0.0.1", 0,   # ephemeral port
+                             host_id="metrics-host")
     t = __import__("threading").Thread(target=httpd.serve_forever,
                                        daemon=True)
     t.start()
@@ -385,11 +388,21 @@ def test_http_metrics_exposition_after_load(tmp_path):
         values = {line.split()[0]: float(line.split()[1])
                   for line in text.splitlines()
                   if line and not line.startswith("#")}
-        assert values["raft_serve_requests_admitted_total"] == 3
-        assert values["raft_serve_requests_completed_total"] == 3
-        assert values["raft_serve_requests_failed_total"] == 0
-        assert values["raft_serve_latency_p50_ms"] > 0
-        assert values["raft_serve_draining"] == 0
+        hl = '{host="metrics-host"}'
+        assert values["raft_serve_requests_admitted_total" + hl] == 3
+        assert values["raft_serve_requests_completed_total" + hl] == 3
+        assert values["raft_serve_requests_failed_total" + hl] == 0
+        assert values["raft_serve_latency_p50_ms" + hl] > 0
+        assert values["raft_serve_draining" + hl] == 0
+        # per-bucket families carry BOTH labels (bucket first, host after);
+        # the fake cache produces no quality window, so exercise the
+        # renderer directly with a seeded bucket
+        from raft_stereo_tpu.serve.http import prometheus_metrics
+        seeded = dict(server.stats(),
+                      quality={"48x96b2i2": {"final_residual_p50": 1.0}})
+        assert ('raft_serve_final_residual_p50'
+                '{bucket="48x96b2i2",host="metrics-host"}'
+                in prometheus_metrics(seeded, host_id="metrics-host"))
         # the --no_metrics plumbing: a metrics-off frontend on the same
         # server 404s the exposition (the handler never reaches the
         # scheduler, so no second model init is needed)
@@ -513,7 +526,7 @@ def test_cli_drift_v3_fires_on_seeded_serve_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 7
+    assert RULE_VERSIONS["cli-drift"] == 8
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "serve").mkdir(parents=True)
     (pkg / "cli.py").write_text(
