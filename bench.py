@@ -173,6 +173,10 @@ def run_bench(batch, h, w, train_iters, steps, fused_loss=False,
             # ops/scan_grad.py) or "autodiff" (the pinned-off control).
             "scan_backward": ("batched_wgrad" if batched_scan_wgrad
                               else "autodiff"),
+            # The correlation A/B flag (r18): which lookup produced this
+            # number — "reg" materializes the B*H*W^2 pyramid, "fused" is
+            # the memoryless W2-blocked Pallas kernel.
+            "corr_implementation": corr_implementation,
         }
         if xla is not None:
             out["xla"] = xla
@@ -275,7 +279,16 @@ def _attempt_chain(on_tpu):
                 dict(kw=dict(batch=2, h=96, w=160, train_iters=4, steps=3,
                              batched_scan_wgrad=True),
                      when="always",
-                     note="scan custom-VJP A/B (batched weight grads)")]
+                     note="scan custom-VJP A/B (batched weight grads)"),
+                # The fused-vs-reg correlation A/B (r18): the first row
+                # above is the reg control; this runs the identical recipe
+                # on the memoryless kernel end-to-end (interpret-mode
+                # Pallas on CPU — a correctness/pipeline artifact, not a
+                # speed number).
+                dict(kw=dict(batch=2, h=96, w=160, train_iters=4, steps=3,
+                             corr_implementation="fused"),
+                     when="always",
+                     note="memoryless fused-corr A/B (reg control above)")]
     recipe = FLAGSHIP_RECIPE
     # The r4-measured winning schedule (9.42 pairs/s): one-shot post-scan
     # upsample (the lax.map chunking's serialization cost -0.12), SAVED
@@ -331,6 +344,19 @@ def _attempt_chain(on_tpu):
              when="always",
              note="scan custom-VJP A/B (batched weight grads, bf16 "
                   "residual stacks); pinned-off control = banker"),
+        # Fused-vs-reg correlation A/B (r18): the banker schedule with the
+        # memoryless W2-blocked lookup in place of the materialized volume
+        # pyramid. `always`, mirroring the scan A/B: if deleting the
+        # B*H*W^2 residency buys throughput (or the banker stops fitting),
+        # this becomes the round's number; either way both rows land in
+        # attempts.jsonl and the banked JSON line carries
+        # corr_implementation. The banker row above is the reg control.
+        dict(kw=dict(batch=8, fused_loss=True,
+                     remat_encoders="blocks_hires",
+                     corr_implementation="fused", **best_sched, **recipe),
+             when="always",
+             note="memoryless fused-corr A/B at the banker schedule; "
+                  "reg control = banker"),
         # The full blocks-remat config: ~1.7 GB less residency than the
         # banker and proven over three rounds of sessions — the next stop
         # if the banker's extra saves stop fitting.

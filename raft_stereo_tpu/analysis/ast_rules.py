@@ -59,12 +59,15 @@ from raft_stereo_tpu.analysis.findings import Finding
 #: they said; v8 adds the fleet surface (build_fleet_parser, consumed by
 #: obs/fleet.py) plus the fleet-observatory plumbing (--no_fleet/
 #: --host_id/--heartbeat_every) on the train, serve and loadtest
-#: surfaces.
+#: surfaces; v9 adds the memoryless fused-correlation plumbing (r18) —
+#: --fused_block_w and the fused/fused_cuda/memoryless impl choices on
+#: the shared model-config surface, plus --fused_width (the per-bucket
+#: program-swap threshold) on the serve surface.
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 8,
+    "cli-drift": 9,
 }
 
 # Call names (last attribute segment) that trace their function arguments.

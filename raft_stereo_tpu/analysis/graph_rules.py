@@ -23,7 +23,16 @@ this module makes them declarative rules over two canonical lowerings:
 * ``inference`` — the ``test_mode`` forward ``StereoPredictor`` jits;
 * ``inference[adaptive]`` — the compiled early-exit flavor (masked
   fixed-trip scan with per-sample freeze, models/raft_stereo.py
-  ``_refine_adaptive``) the ``--iter_policy`` eval/serve paths run.
+  ``_refine_adaptive``) the ``--iter_policy`` eval/serve paths run;
+* ``train_step[batched,fused]`` — the batched custom-VJP step under the
+  memoryless ``fused`` correlation (r18): the residual-dtype and
+  wgrad-placement contracts must hold when the scan-carried corr state is
+  the feature pyramid instead of the volume;
+* ``inference[wide]`` / ``inference[fused]`` — the same forward compiled
+  at a WIDE width (where the B·H·W² volume, quadratic in W, overtakes the
+  linear-in-W encoder activations): the pair's peak-bytes gap in the
+  fingerprint is the standing record that ``fused`` deletes the volume's
+  residency, and the gate that notices it quietly coming back.
 
 Same jaxpr topology as the real shapes (shape enters only aval sizes), so
 every placement/dtype/callback contract checked here holds for the TPU
@@ -44,11 +53,14 @@ from raft_stereo_tpu.analysis.findings import Finding
 
 #: current semantic version per rule (suppression baseline entries record
 #: the version they were written against; findings.apply_baseline flags a
-#: mismatch stale instead of silently matching a changed rule)
+#: mismatch stale instead of silently matching a changed rule).
+#: residual-dtype-conformance is v2: the contract now also runs over the
+#: ``train_step[batched,fused]`` lowering (r18) — an old suppression could
+#: not have meant the fused-corr residual stacks, so it goes stale.
 RULE_VERSIONS: Dict[str, int] = {
     "wgrad-in-loop": 1,
     "dtype-drift": 1,
-    "residual-dtype-conformance": 1,
+    "residual-dtype-conformance": 2,
     "host-sync": 1,
     "donation": 1,
     "carry-growth": 1,
@@ -460,7 +472,8 @@ def run_rules_on_target(target: GraphTarget,
 # --- canonical targets -------------------------------------------------------
 
 def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
-                  compile_train: bool = True) -> List[GraphTarget]:
+                  compile_train: bool = True,
+                  fused_w: int = 24576) -> List[GraphTarget]:
     """Lower the canonical step functions at a tiny shape (same topology as
     the production shapes — only aval sizes differ).
 
@@ -472,7 +485,14 @@ def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
     step with the anomaly-guard ``lax.cond``, compiled donated), and the
     ``test_mode`` ``inference`` forward. One model init is shared: the
     variant configs differ only in backward scheduling, never in
-    parameters."""
+    parameters.
+
+    ``fused_w`` sets the width of the ``inference[wide]``/
+    ``inference[fused]`` pair (compiled only when ``compile_train``): wide
+    enough that the reg volume pyramid — quadratic in W — dominates the
+    program peak, so the fingerprint's peak-bytes field records the
+    residency the memoryless kernel deletes. Compile cost is
+    width-independent (op counts, not aval sizes, drive XLA here)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -570,6 +590,47 @@ def build_targets(batch: int = 1, h: int = 32, w: int = 48, iters: int = 3,
         name="inference[adaptive]", cfg=base,
         closed_jaxpr=jax.make_jaxpr(infer_adaptive)(variables, img1, img2),
         platform=platform))
+
+    # 6) batched custom-VJP step under the memoryless fused correlation:
+    # the residual-dtype and wgrad-placement contracts must hold when the
+    # corr state carried by the scan is the feature pyramid, not the
+    # volume (+ its autodiff twin for the placement diff)
+    cfg_fb = dataclasses.replace(base, corr_implementation="fused",
+                                 batched_scan_wgrad=True,
+                                 refinement_save_policy=False,
+                                 residual_dtype="bfloat16")
+    cfg_fa = dataclasses.replace(base, corr_implementation="fused",
+                                 refinement_save_policy=False)
+    targets.append(GraphTarget(
+        name="train_step[batched,fused]", cfg=cfg_fb,
+        closed_jaxpr=jax.make_jaxpr(grad_fn(cfg_fb))(params),
+        platform=platform,
+        variants={"autodiff": jax.make_jaxpr(grad_fn(cfg_fa))(params)}))
+
+    # 7) the wide fused-vs-reg inference pair: at fused_w the reg volume
+    # pyramid (quadratic in W) overtakes the linear-in-W encoder stem
+    # activations, so the two targets' peak_bytes fields bank the claim
+    # "fused deletes the volume's residency" as a diffable number
+    img1_w = jnp.asarray(rng.uniform(0, 255, (1, h, fused_w, 3)),
+                         jnp.float32)
+    img2_w = jnp.asarray(rng.uniform(0, 255, (1, h, fused_w, 3)),
+                         jnp.float32)
+    cfg_f = dataclasses.replace(base, corr_implementation="fused")
+    for name, cfg_w in (("inference[wide]", base),
+                        ("inference[fused]", cfg_f)):
+        m_w = create_model(cfg_w)
+
+        def infer_w(v, a, b, m_w=m_w):
+            return m_w.apply(v, a, b, iters=iters, test_mode=True)
+
+        compiled_w = None
+        if compile_train:
+            compiled_w = jax.jit(infer_w).lower(variables, img1_w,
+                                                img2_w).compile()
+        targets.append(GraphTarget(
+            name=name, cfg=cfg_w,
+            closed_jaxpr=jax.make_jaxpr(infer_w)(variables, img1_w, img2_w),
+            compiled=compiled_w, platform=platform))
     return targets
 
 

@@ -21,9 +21,12 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    help="hidden state and context dimensions")
     g.add_argument("--corr_implementation",
                    choices=["reg", "alt", "reg_cuda", "alt_cuda",
-                            "reg_pallas", "alt_pallas", "ring"], default="reg",
+                            "reg_pallas", "alt_pallas", "ring", "fused",
+                            "fused_cuda", "memoryless"], default="reg",
                    help="correlation volume implementation "
-                        "(*_cuda aliases map to the *_pallas TPU kernels; "
+                        "(reg_cuda aliases reg_pallas; alt_cuda/fused_cuda/"
+                        "memoryless alias fused, the memoryless W2-blocked "
+                        "kernel that never builds the B*H*W^2 volume; "
                         "ring = width-sharded sequence parallelism)")
     g.add_argument("--shared_backbone", action="store_true",
                    help="use a single backbone for context and feature nets")
@@ -46,7 +49,12 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
                    choices=["float32", "bfloat16"], default=None,
                    help="correlation-volume storage precision; default "
                         "matches the reference (fp32 for reg/alt, compute "
-                        "dtype for the *_pallas kernels)")
+                        "dtype for the *_pallas and fused kernels)")
+    g.add_argument("--fused_block_w", type=int, default=256,
+                   help="W2 tile width (lanes) for the memoryless 'fused' "
+                        "correlation kernel; bounds its VMEM sub-slab "
+                        "independent of image width (halved further under "
+                        "pressure)")
     g.add_argument("--fused_lookup", choices=["auto", "on", "off"],
                    default="auto",
                    help="fused pyramid-lookup+convc1 Pallas kernel (auto: "
@@ -93,6 +101,7 @@ def model_config(args: argparse.Namespace) -> RAFTStereoConfig:
         mixed_precision=args.mixed_precision,
         remat_refinement=not getattr(args, "no_remat", False),
         corr_storage_dtype=getattr(args, "corr_storage_dtype", None),
+        fused_block_w=getattr(args, "fused_block_w", 256),
         fused_lookup={"auto": None, "on": True, "off": False}[
             getattr(args, "fused_lookup", "auto")],
         remat_loss_tail=not getattr(args, "no_remat_loss_tail", False),
@@ -403,6 +412,10 @@ def add_serve_args(parser: argparse.ArgumentParser) -> None:
                         "--iter_policy is given; off ignores a loaded "
                         "policy and serves the fixed-trip programs — the "
                         "bitwise pre-adaptive pin)")
+    g.add_argument("--fused_width", type=int, default=0,
+                   help="serve buckets padded to at least this width via "
+                        "the memoryless 'fused' correlation flavor "
+                        "(per-bucket program swap; 0 = off)")
 
 
 def serve_config(args: argparse.Namespace):
@@ -413,7 +426,8 @@ def serve_config(args: argparse.Namespace):
         linger_s=args.linger_ms / 1e3, aot=not args.no_aot,
         slo_every=args.slo_every, converge=not args.no_converge,
         numerics=args.numerics, iter_policy=args.iter_policy,
-        adaptive={"auto": None, "on": True, "off": False}[args.adaptive])
+        adaptive={"auto": None, "on": True, "off": False}[args.adaptive],
+        fused_width=getattr(args, "fused_width", 0))
 
 
 def _parse_shapes(specs) -> list:
@@ -822,8 +836,9 @@ def _eval_main():
         format="%(asctime)s %(levelname)s %(filename)s:%(lineno)d %(message)s")
     # the reference enables mixed precision automatically for the kernel
     # implementations (evaluate_stereo.py:229-231); mirror that for the
-    # pallas variants (and their *_cuda aliases)
-    if args.corr_implementation.endswith(("_cuda", "_pallas")) \
+    # pallas/fused variants (and their *_cuda aliases)
+    if (args.corr_implementation.endswith(("_cuda", "_pallas"))
+            or args.corr_implementation in ("fused", "memoryless")) \
             and not args.mixed_precision:
         logging.getLogger(__name__).info(
             "enabling mixed precision for %s", args.corr_implementation)

@@ -17,10 +17,22 @@ from typing import Optional, Sequence, Tuple, Union
 # core/raft_stereo.py:90-100). "reg_pallas"/"alt_pallas" replace the CUDA
 # extensions ("reg_cuda"/"alt_cuda") with TPU Pallas kernels; "ring" is the
 # sequence-parallel variant for very wide images (W sharded over the mesh's
-# 'seq' axis, fmap2 blocks ppermuted ring-style — SURVEY §5 long-context row).
-CORR_IMPLEMENTATIONS = ("reg", "alt", "reg_pallas", "alt_pallas", "ring")
-# Aliases so reference command lines keep working.
-CORR_ALIASES = {"reg_cuda": "reg_pallas", "alt_cuda": "alt_pallas"}
+# 'seq' axis, fmap2 blocks ppermuted ring-style — SURVEY §5 long-context row);
+# "fused" is the memoryless W2-blocked kernel (ops/pallas/corr_kernels.py):
+# alt's O(W) state with a lookup whose largest transient is a
+# (rows, W1, fused_block_w) VMEM sub-slab — no level's B*H*W1*W2 volume
+# exists at ANY width, forward or backward (alt_pallas falls back to the
+# full volume when its whole-row slab outgrows VMEM; fused shrinks its
+# block instead).
+CORR_IMPLEMENTATIONS = ("reg", "alt", "reg_pallas", "alt_pallas", "ring",
+                        "fused")
+# Aliases so reference command lines keep working. The reference points its
+# high-resolution spellings at the memory-frugal path: "alt_cuda" is its
+# never-shipped on-the-fly extension (core/corr.py:159-188), so it routes —
+# along with the explicit "fused_cuda"/"memoryless" spellings — onto "fused",
+# the implementation that actually delivers that promise.
+CORR_ALIASES = {"reg_cuda": "reg_pallas", "alt_cuda": "fused",
+                "fused_cuda": "fused", "memoryless": "fused"}
 
 NORM_FNS = ("group", "batch", "instance", "none")
 
@@ -173,13 +185,25 @@ class RAFTStereoConfig:
     # whole batch settles early, but the program is not expressible as a
     # fixed-length scan).
     adaptive_mode: str = "masked_scan"
+    # Ours: W2 tile width (lanes) for the memoryless "fused" correlation
+    # kernel's blocked grid. Bounds the kernel's largest transient —
+    # (rows, W1, fused_block_w) fp32 in VMEM — independent of image width;
+    # the kernel halves it further under VMEM pressure, so this is a
+    # ceiling, not a promise. 256 = two 128-lane tiles per block, trading
+    # grid-step overhead against residency; sweep it on hardware via
+    # --fused_block_w before trusting another value.
+    fused_block_w: int = 256
 
     def __post_init__(self):
         impl = CORR_ALIASES.get(self.corr_implementation, self.corr_implementation)
         object.__setattr__(self, "corr_implementation", impl)
         object.__setattr__(self, "hidden_dims", tuple(self.hidden_dims))
         if impl not in CORR_IMPLEMENTATIONS:
-            raise ValueError(f"unknown corr_implementation {impl!r}")
+            aliases = ", ".join(f"{a!r}->{t!r}"
+                                for a, t in sorted(CORR_ALIASES.items()))
+            raise ValueError(
+                f"unknown corr_implementation {impl!r}; registered: "
+                f"{list(CORR_IMPLEMENTATIONS)} (aliases: {aliases})")
         if self.context_norm not in NORM_FNS:
             raise ValueError(f"unknown context_norm {self.context_norm!r}")
         if not 1 <= self.n_gru_layers <= 3:
@@ -216,6 +240,12 @@ class RAFTStereoConfig:
             raise ValueError(
                 f"batched_scan_wgrad must be None (auto), True or False, "
                 f"got {self.batched_scan_wgrad!r}")
+        if not (isinstance(self.fused_block_w, int)
+                and self.fused_block_w >= 2 * self.corr_radius + 3):
+            # the blocked window slice needs 2r+3 lanes per block minimum
+            raise ValueError(
+                f"fused_block_w must be an int >= 2*corr_radius+3 "
+                f"(= {2 * self.corr_radius + 3}), got {self.fused_block_w!r}")
         if self.adaptive_mode not in ("masked_scan", "while_loop"):
             raise ValueError(
                 f"adaptive_mode must be 'masked_scan' or 'while_loop', "

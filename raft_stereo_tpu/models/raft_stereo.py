@@ -494,14 +494,16 @@ class RAFTStereo(nn.Module):
         # compute dtype for the Pallas kernels (fp16 CUDA precedent).
         if cfg.corr_storage_dtype is not None:
             storage_dt = jnp.dtype(cfg.corr_storage_dtype)
-        elif cfg.corr_implementation.endswith("_pallas"):
+        elif (cfg.corr_implementation.endswith("_pallas")
+              or cfg.corr_implementation == "fused"):
             storage_dt = dt
         else:
             storage_dt = None
         corr_state = init_corr(cfg.corr_implementation, fmap1, fmap2,
                                num_levels=cfg.corr_levels,
                                radius=cfg.corr_radius,
-                               storage_dtype=storage_dt)
+                               storage_dtype=storage_dt,
+                               block_w=cfg.fused_block_w)
 
         # Fused lookup+convc1 kernel: applicable only for volume-pyramid
         # implementations whose shapes fit the kernel tiling (the check is

@@ -562,6 +562,62 @@ def summarize_buffer_assignment(text: str, top: int = 8) -> Dict[str, Any]:
     }
 
 
+_SHAPE_DIMS_RE = re.compile(r"\[([0-9,]*)\]")
+
+
+def volume_class_summary(text: str, w1: int, h1: int, num_levels: int = 4,
+                         top: int = 4, min_width: int = 16
+                         ) -> Dict[str, Any]:
+    """The correlation-volume allocation class, by name.
+
+    Scans EVERY value in a buffer-assignment dump (not just the top-N the
+    summary keeps) for shapes trailing in ``(W1, W2_level)`` — the all-pairs
+    volume and its pooled pyramid/scan-stacked descendants, ``W2_level``
+    walking the floor-halving pool chain from ``W1``. Leading dims must
+    cover at least ``h1`` rows (``h1`` = the feature-map height): the class
+    is the per-IMAGE O(H*W^2) residency, not any bounded per-block slab
+    (e.g. the fused kernel's (rows<=8, W1, block) interpret-mode transient).
+    Pool levels at or below ``min_width`` lanes are excluded: those levels
+    are linear-in-W small, and their widths collide with the (2r+2)-lane
+    tap stacks every on-the-fly lookup legitimately builds — the class
+    names the QUADRATIC residency, which lives in the wide levels.
+    This is the class the r7 breakdown named dominant and the memoryless
+    ``fused`` lookup deletes: under ``fused`` the count must be ZERO, which
+    aggregate ``memory_analysis`` totals can suggest but never prove.
+    """
+    widths = set()
+    w = int(w1)
+    for _ in range(num_levels):
+        if w > min_width:
+            widths.add(w)
+        w //= 2
+    parsed = parse_buffer_assignment(text)
+    hits = []
+    for a in parsed["allocations"]:
+        for v in a["values"]:
+            m = _SHAPE_DIMS_RE.search(v["shape"])
+            if not m or not m.group(1):
+                continue
+            dims = [int(x) for x in m.group(1).split(",") if x]
+            lead = 1
+            for x in dims[:-2]:
+                lead *= x
+            if (len(dims) >= 3 and dims[-2] == w1 and dims[-1] in widths
+                    and lead >= h1):
+                hits.append({**v, "allocation": a["index"],
+                             "kind": a["kind"]})
+    hits.sort(key=lambda v: -v["size"])
+    return {
+        "w1": int(w1), "h1": int(h1),
+        "pool_widths": sorted(widths, reverse=True),
+        "count": len(hits),
+        "bytes": sum(v["size"] for v in hits),
+        "largest": [{"instruction": v["instruction"], "shape": v["shape"],
+                     "size": v["size"], "kind": v["kind"]}
+                    for v in hits[:top]],
+    }
+
+
 def find_buffer_assignment(dump_dir: str) -> Optional[str]:
     """Pick the main module's buffer-assignment file from an
     ``--xla_dump_to`` directory (the largest one — jit wrapper modules for

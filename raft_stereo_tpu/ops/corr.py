@@ -41,6 +41,9 @@ class CorrState:
     impl: str = struct.field(pytree_node=False)
     radius: int = struct.field(pytree_node=False)
     num_levels: int = struct.field(pytree_node=False, default=4)
+    # W2 block width for the memoryless 'fused' kernel (static metadata, not
+    # a pytree leaf — it selects the Pallas grid, so it must be trace-static)
+    block_w: int = struct.field(pytree_node=False, default=256)
 
 
 def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
@@ -57,7 +60,7 @@ def all_pairs_correlation(fmap1: jax.Array, fmap2: jax.Array) -> jax.Array:
 
 
 def _build_reg(fmap1, fmap2, num_levels, radius,
-               storage_dtype=None) -> CorrState:
+               storage_dtype=None, block_w=None) -> CorrState:
     volume = all_pairs_correlation(fmap1.astype(jnp.float32),
                                    fmap2.astype(jnp.float32))
     if storage_dtype is not None:
@@ -74,7 +77,7 @@ def _build_reg(fmap1, fmap2, num_levels, radius,
 
 
 def _build_alt(fmap1, fmap2, num_levels, radius,
-               storage_dtype=None) -> CorrState:
+               storage_dtype=None, block_w=None) -> CorrState:
     dt = storage_dtype or jnp.float32
     fmap1 = fmap1.astype(dt)
     fmap2 = fmap2.astype(dt)
@@ -83,6 +86,24 @@ def _build_alt(fmap1, fmap2, num_levels, radius,
         levels.append(pool_w2(levels[-1]))
     return CorrState(levels=tuple(levels), fmap1=fmap1, impl="alt",
                      radius=radius, num_levels=num_levels)
+
+
+def _build_fused(fmap1, fmap2, num_levels, radius,
+                 storage_dtype=None, block_w=None) -> CorrState:
+    """Memoryless fused state: the same O(W) pyramid as ``alt`` (pooled
+    fmap2 + fmap1 — the scan carry shrinks identically), but the lookup is
+    the W2-blocked Pallas kernel, which never materializes ANY level's
+    (W1, W2) slab — in HBM or VMEM — at any width (``alt_pallas`` falls back
+    to the full volume when its whole-row slab outgrows VMEM)."""
+    dt = storage_dtype or jnp.float32
+    fmap1 = fmap1.astype(dt)
+    fmap2 = fmap2.astype(dt)
+    levels = [fmap2]
+    for _ in range(num_levels - 1):
+        levels.append(pool_w2(levels[-1]))
+    return CorrState(levels=tuple(levels), fmap1=fmap1, impl="fused",
+                     radius=radius, num_levels=num_levels,
+                     block_w=int(block_w or 256))
 
 
 def _lookup_reg(state: CorrState, coords_x: jax.Array) -> jax.Array:
@@ -121,7 +142,7 @@ def _lookup_alt(state: CorrState, coords_x: jax.Array) -> jax.Array:
 
 
 def _build_ring(fmap1, fmap2, num_levels, radius,
-                storage_dtype=None) -> CorrState:
+                storage_dtype=None, block_w=None) -> CorrState:
     """Ring-sharded alt: keep raw feature maps; pooling happens per ring
     block inside the lookup (parallel/ring_corr.py).
 
@@ -219,11 +240,12 @@ _LOOKUPS: Dict[str, Callable] = {}
 def register_corr(name: str, builder: Callable, lookup: Callable) -> None:
     """Register a correlation implementation (the plugin registry).
 
-    ``builder(fmap1, fmap2, num_levels, radius, *, storage_dtype=None)
-    -> CorrState`` and ``lookup(state, coords_x) -> (B, H, W1,
+    ``builder(fmap1, fmap2, num_levels, radius, *, storage_dtype=None,
+    block_w=None) -> CorrState`` and ``lookup(state, coords_x) -> (B, H, W1,
     num_levels*(2r+1))`` features. ``storage_dtype`` requests
-    reduced-precision state storage (builders may ignore it, but must accept
-    the keyword). New strategies (e.g. a ring-sharded variant for very wide
+    reduced-precision state storage and ``block_w`` a W2 tile width for
+    blocked kernels (builders may ignore either, but must accept the
+    keywords). New strategies (e.g. a ring-sharded variant for very wide
     images) plug in here without touching the model.
     """
     _BUILDERS[name] = builder
@@ -237,21 +259,24 @@ register_corr("ring", _build_ring, _lookup_ring)
 
 def init_corr(impl: str, fmap1: jax.Array, fmap2: jax.Array, *,
               num_levels: int = 4, radius: int = 4,
-              storage_dtype=None) -> CorrState:
+              storage_dtype=None, block_w=None) -> CorrState:
     """Build correlation state from NHWC feature maps ``(B, H, W, D)``.
 
     ``storage_dtype`` (e.g. ``jnp.bfloat16``) selects reduced-precision
     storage for the volume/feature pyramid; ``None`` keeps fp32 (the
     reference's default for reg/alt, core/raft_stereo.py:92-95). Lookup
-    accumulation is fp32 either way.
+    accumulation is fp32 either way. ``block_w`` sets the W2 tile width of
+    the memoryless ``fused`` kernel (config.fused_block_w; other builders
+    ignore it).
     """
-    if impl not in _BUILDERS and impl.endswith("_pallas"):
+    if impl not in _BUILDERS and (impl.endswith("_pallas")
+                                  or impl == "fused"):
         _maybe_register_pallas()
     if impl not in _BUILDERS:
         raise ValueError(f"unknown corr implementation {impl!r}; "
                          f"registered: {sorted(_BUILDERS)}")
     return _BUILDERS[impl](fmap1, fmap2, num_levels, radius,
-                           storage_dtype=storage_dtype)
+                           storage_dtype=storage_dtype, block_w=block_w)
 
 
 def corr_lookup(state: CorrState, coords: jax.Array) -> jax.Array:
@@ -288,6 +313,21 @@ def _lookup_alt_pallas(state: CorrState, coords_x: jax.Array) -> jax.Array:
     return jnp.concatenate(out, axis=-1)
 
 
+def _lookup_fused(state: CorrState, coords_x: jax.Array) -> jax.Array:
+    """Memoryless W2-blocked lookup: per level, the largest transient is a
+    (Hb, W1, block_w) VMEM sub-slab — no level's volume is ever built, in
+    HBM or VMEM, at any width (ops/pallas/corr_kernels.py, the working
+    version of arXiv 2505.16942's on-the-fly sampling for 1-D disparity)."""
+    from raft_stereo_tpu.ops.pallas.corr_kernels import (
+        fused_windowed_corr_pallas)
+    out = []
+    for i, fmap2 in enumerate(state.levels):
+        out.append(fused_windowed_corr_pallas(
+            state.fmap1, fmap2, coords_x / (2 ** i), state.radius,
+            state.block_w))
+    return jnp.concatenate(out, axis=-1)
+
+
 def _maybe_register_pallas() -> None:
     """Lazily register the Pallas-fused implementations.
 
@@ -306,8 +346,14 @@ def _maybe_register_pallas() -> None:
             register_corr("reg_pallas", _build_reg, _lookup_reg)
         if "alt_pallas" not in _BUILDERS:
             register_corr("alt_pallas", _build_alt, _lookup_alt)
+        if "fused" not in _BUILDERS:
+            # same state pytree, alt-semantics lookup — selectable
+            # everywhere, just without the memoryless guarantee
+            register_corr("fused", _build_fused, _lookup_alt)
         return
     if "reg_pallas" not in _BUILDERS:
         register_corr("reg_pallas", _build_reg, _lookup_reg_pallas)
     if "alt_pallas" not in _BUILDERS:
         register_corr("alt_pallas", _build_alt, _lookup_alt_pallas)
+    if "fused" not in _BUILDERS:
+        register_corr("fused", _build_fused, _lookup_fused)

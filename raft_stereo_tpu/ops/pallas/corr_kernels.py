@@ -20,6 +20,16 @@ TPU-native equivalents of the reference's CUDA extension
   row^T / sqrt(D)) and samples it without ever writing the O(W^2) volume to
   HBM — the capability the reference's absent ``alt_cuda_corr`` extension
   promises (core/corr.py:159-188), with O(W) HBM footprint.
+* :func:`fused_windowed_corr_pallas` — the memoryless blocked kernel behind
+  ``corr_implementation='fused'``: like the alt kernel it fuses the feature
+  dot-product into the windowed sample, but it tiles the W2 axis into
+  ``block_w``-lane blocks and ACCUMULATES the blended taps across blocks, so
+  the per-program slab is (Hb, W1, Wb) — bounded by a knob, not by the image
+  — and there is NO full-volume fallback at any width (the alt kernel falls
+  back to materializing B*H*W1*W2 when its slab outgrows VMEM; this one
+  shrinks the block instead). The hand VJP mirrors the tiling: fmap1
+  cotangents accumulate across W2 blocks, fmap2 cotangents are written per
+  block, and no forward-saved volume exists anywhere.
 
 On non-TPU backends every ``pallas_call`` runs in interpreter mode, so the
 same kernels are unit-testable on CPU (tests/test_pallas_corr.py).
@@ -360,3 +370,231 @@ def _alt_pallas_bwd(radius, res, ct):
 
 
 alt_windowed_corr_pallas.defvjp(_alt_pallas_fwd, _alt_pallas_bwd)
+
+
+# ------------------------------------- memoryless fused corr (W2-blocked)
+#
+# The alt kernel above keeps one (Hb, W1, W2) slab per program, so past
+# ~Middlebury widths it must fall back to materializing the full volume —
+# exactly the residency this kernel exists to delete. Here W2 is tiled into
+# Wb-lane blocks (grid axis v, innermost): each program builds only the
+# (Hb, W1, Wb) sub-slab on the MXU, extracts the window taps that land
+# INSIDE its block (the barrel-shifter mask drops the rest; every global tap
+# lands in exactly one block), and accumulates the blended result into an
+# output block whose index_map ignores v — the TPU revisiting guarantee
+# keeps it resident across the whole W2 sweep. The blend is linear in the
+# taps, so per-block blended accumulation is exact, not approximate.
+#
+# fmap2 is zero-padded up to a Wb multiple: a zero feature row correlates to
+# zero, so padded taps contribute nothing to the forward, and the backward
+# slices the padded rows back off df2 (their dvol contributions hit zero
+# features, so df1 is untouched too).
+
+
+def _fused_reference(fmap1, fmap2, center, radius):
+    """Pure-JAX memoryless lookup: per-tap gather + dot, O(W) residency.
+
+    Covers the degenerate pyramid levels (W2 <= 2r+2, fewer lanes than the
+    window machinery needs) and any shape the blocked kernel cannot tile.
+    Never builds a (W1, W2) slab: each of the 2r+2 taps is one fmap1-sized
+    gather + reduce, strictly smaller than the lookup's own output.
+    """
+    w2 = fmap2.shape[2]
+    k = 2 * radius + 1
+    d = fmap1.shape[-1]
+    scale = 1.0 / float(d) ** 0.5
+    c = center.astype(jnp.float32)
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    base = base_f.astype(jnp.int32) - radius
+    f1 = fmap1.astype(jnp.float32)
+    f2 = fmap2.astype(jnp.float32)
+    taps = []
+    for j in range(k + 1):
+        idx = base + j                                   # (B, H, W1)
+        valid = (idx >= 0) & (idx < w2)
+        safe = jnp.clip(idx, 0, w2 - 1)
+        f2_tap = jnp.take_along_axis(f2, safe[..., None], axis=2)
+        tap = jnp.sum(f1 * f2_tap, axis=-1) * scale
+        taps.append(jnp.where(valid, tap, 0.0))
+    g = jnp.stack(taps, axis=-1)                         # (B, H, W1, 2r+2)
+    return (1.0 - frac) * g[..., :k] + frac * g[..., 1:]
+
+
+def _fused_tiles(h, w1, w2, d, k, block_w):
+    """``(hb, wb, nv, w2p)`` tiling for the blocked kernel, or ``None``.
+
+    ``wb`` starts at ``min(block_w, w2)`` (floored at the 2r+3 lanes the
+    window slice needs) and HALVES until the per-program residency fits
+    ``_VMEM_BUDGET_BYTES`` — the memoryless answer to pressure, where the
+    alt kernel gives up and materializes. ``None`` only for degenerate
+    windows or a single row that cannot fit at the minimum block."""
+    if w2 <= k + 1:
+        return None
+    wb = max(min(int(block_w), w2), k + 2)
+    while True:
+        # fp32 residents per row: f1 + df1 (w1*d), f2 + df2 (wb*d), the
+        # sub-slab + its scatter twin (w1*wb), window/tap temps (w1*(k+1)).
+        hb = _row_block(h, 4 * (2 * w1 * d + 2 * wb * d
+                                + 2 * w1 * wb + 2 * w1 * (k + 1)))
+        if hb:
+            nv = -(-w2 // wb)
+            return hb, wb, nv, nv * wb
+        if wb <= k + 2:
+            return None
+        wb = max(wb // 2, k + 2)
+
+
+def _pad_w2(fmap2, w2p):
+    w2 = fmap2.shape[2]
+    if w2p == w2:
+        return fmap2
+    return jnp.pad(fmap2, ((0, 0), (0, 0), (0, w2p - w2), (0, 0)))
+
+
+def _fused_fwd_kernel(radius, scale, wb, coords_ref, f1_ref, f2_ref, out_ref):
+    v = pl.program_id(2)
+    c = coords_ref[0]                            # (Hb, W1)
+    f1 = f1_ref[0]                               # (Hb, W1, D)
+    f2 = f2_ref[0]                               # (Hb, Wb, D)
+    k = 2 * radius + 1
+
+    @pl.when(v == 0)
+    def _init():
+        out_ref[0] = jnp.zeros(out_ref.shape[1:], out_ref.dtype)
+
+    # this block's (Hb, W1, Wb) sub-slab on the MXU; never leaves VMEM
+    vol = jax.lax.dot_general(
+        f1, f2, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale
+
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    # block-local tap base: taps outside [0, wb) are zeroed by the window
+    # mask, so each global tap contributes from exactly one block
+    base = base_f.astype(jnp.int32) - radius - v * wb
+    g = _extract_window(vol, base, radius)
+    out_ref[0] += (1.0 - frac) * g[..., :k] + frac * g[..., 1:]
+
+
+def _fused_bwd_kernel(radius, scale, wb, coords_ref, f1_ref, f2_ref, ct_ref,
+                      df1_ref, df2_ref):
+    v = pl.program_id(2)
+    c = coords_ref[0]
+    f1 = f1_ref[0]
+    f2 = f2_ref[0]
+    ct = ct_ref[0].astype(jnp.float32)
+
+    @pl.when(v == 0)
+    def _init():
+        df1_ref[0] = jnp.zeros(df1_ref.shape[1:], df1_ref.dtype)
+
+    base_f = jnp.floor(c)
+    frac = (c - base_f)[..., None]
+    base = base_f.astype(jnp.int32) - radius - v * wb
+
+    zeros = jnp.zeros_like(ct[..., :1])
+    dg = (jnp.concatenate([(1.0 - frac) * ct, zeros], axis=-1)
+          + jnp.concatenate([zeros, frac * ct], axis=-1))
+    # taps outside this block are masked before the scatter, mirroring the
+    # forward's per-block window mask
+    dvol = _scatter_window(dg, base, radius, wb) * scale  # (Hb, W1, Wb)
+
+    # df1 accumulates across the W2 sweep (fp32 accumulator, index_map
+    # ignores v); df2 is per-block, written once
+    df1_ref[0] += jax.lax.dot_general(
+        dvol, f2.astype(jnp.float32),
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    df2_ref[0] = jax.lax.dot_general(
+        dvol, f1.astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(df2_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_windowed_corr_pallas(fmap1: jax.Array, fmap2: jax.Array,
+                               center: jax.Array, radius: int,
+                               block_w: int = 256) -> jax.Array:
+    """Memoryless fused correlation lookup, W2-blocked.
+
+    ``fmap1 (B, H, W1, D)``, ``fmap2 (B, H, W2, D)``, ``center (B, H, W1)``
+    -> ``(B, H, W1, 2r+1)`` with the 1/sqrt(D) scaling applied — same
+    semantics as :func:`alt_windowed_corr_pallas` and the reg volume lookup,
+    but the largest transient is the (Hb, W1, min(block_w, W2)) sub-slab:
+    no B*H*W1*W2 volume exists in HBM OR as a whole-row VMEM slab at any
+    width, forward or backward. ``block_w`` trades VMEM residency against
+    grid steps (config.fused_block_w / --fused_block_w).
+
+    The coords gradient is intentionally not produced (the model detaches
+    coords each iteration, raft_stereo.py:109, matching the reference CUDA
+    backward's None, core/corr.py:29).
+    """
+    return _fused_pallas_fwd(fmap1, fmap2, center, radius, block_w)[0]
+
+
+def _fused_pallas_fwd(fmap1, fmap2, center, radius, block_w):
+    b, h, w1, d = fmap1.shape
+    w2 = fmap2.shape[2]
+    k = 2 * radius + 1
+    tiles = _fused_tiles(h, w1, w2, d, k, block_w)
+    if tiles is None:
+        return (_fused_reference(fmap1, fmap2, center, radius),
+                (fmap1, fmap2, center))
+    hb, wb, nv, w2p = tiles
+    scale = 1.0 / float(d) ** 0.5
+    out = pl.pallas_call(
+        functools.partial(_fused_fwd_kernel, radius, scale, wb),
+        grid=(b, h // hb, nv),
+        in_specs=[
+            pl.BlockSpec((1, hb, w1), lambda i, j, v: (i, j, 0)),
+            pl.BlockSpec((1, hb, w1, d), lambda i, j, v: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, wb, d), lambda i, j, v: (i, j, v, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hb, w1, k), lambda i, j, v: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, w1, k), jnp.float32),
+        interpret=_interpret(),
+    )(center.astype(jnp.float32), fmap1, _pad_w2(fmap2, w2p))
+    return out, (fmap1, fmap2, center)
+
+
+def _fused_pallas_bwd(radius, block_w, res, ct):
+    fmap1, fmap2, center = res
+    b, h, w1, d = fmap1.shape
+    w2 = fmap2.shape[2]
+    k = 2 * radius + 1
+    tiles = _fused_tiles(h, w1, w2, d, k, block_w)
+    if tiles is None:
+        _, vjp = jax.vjp(
+            lambda a, b2: _fused_reference(a, b2, center, radius),
+            fmap1, fmap2)
+        df1, df2 = vjp(ct.astype(jnp.float32))
+        return df1.astype(fmap1.dtype), df2.astype(fmap2.dtype), None
+    hb, wb, nv, w2p = tiles
+    scale = 1.0 / float(d) ** 0.5
+    df1, df2 = pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, radius, scale, wb),
+        grid=(b, h // hb, nv),
+        in_specs=[
+            pl.BlockSpec((1, hb, w1), lambda i, j, v: (i, j, 0)),
+            pl.BlockSpec((1, hb, w1, d), lambda i, j, v: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, wb, d), lambda i, j, v: (i, j, v, 0)),
+            pl.BlockSpec((1, hb, w1, k), lambda i, j, v: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hb, w1, d), lambda i, j, v: (i, j, 0, 0)),
+            pl.BlockSpec((1, hb, wb, d), lambda i, j, v: (i, j, v, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, w1, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, w2p, d), fmap2.dtype),
+        ],
+        interpret=_interpret(),
+    )(center.astype(jnp.float32), fmap1, _pad_w2(fmap2, w2p),
+      ct.astype(jnp.float32))
+    if w2p != w2:
+        df2 = df2[:, :, :w2]
+    return df1.astype(fmap1.dtype), df2, None
+
+
+fused_windowed_corr_pallas.defvjp(_fused_pallas_fwd, _fused_pallas_bwd)

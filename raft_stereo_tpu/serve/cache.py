@@ -53,11 +53,17 @@ class BucketKey(NamedTuple):
     #: the key so a policy swap can never silently reuse executables
     #: compiled against different (tau, budget, min_iters) constants.
     policy: str = ""
+    #: correlation implementation the program was compiled with — "" is the
+    #: server config's default. Part of the key so bucket flavors compiled
+    #: against different lookup kernels (e.g. reg vs the memoryless fused)
+    #: can coexist in one cache without executable reuse across impls.
+    impl: str = ""
 
     def label(self) -> str:
         return (f"{self.height}x{self.width}b{self.batch}i{self.iters}"
                 f"{'w' if self.warm else ''}"
-                f"{'@' + self.policy if self.policy else ''}")
+                f"{'@' + self.policy if self.policy else ''}"
+                f"{'+' + self.impl if self.impl else ''}")
 
 
 class ExecutableCache:
@@ -159,6 +165,14 @@ class ExecutableCache:
 
     def _build(self, key: BucketKey):
         model, iters = self.model, key.iters
+        if key.impl and key.impl != self.cfg.corr_implementation:
+            # impl-flavored bucket: same variables (the model is fully
+            # convolutional and the corr impl touches no parameters), a
+            # different lookup program — e.g. the memoryless 'fused' flavor
+            # for wide buckets whose reg volume would not fit.
+            import dataclasses
+            model = create_model(dataclasses.replace(
+                self.cfg, corr_implementation=key.impl))
         converge = self.converge
         numerics = self.numerics
         entry = self.bucket_entry(key.height, key.width) if key.policy \

@@ -124,6 +124,11 @@ class ServeConfig:
     #: is set, False ignores a loaded policy (the pre-adaptive bitwise
     #: pin), True without a policy is an error.
     adaptive: Optional[bool] = None
+    #: serve buckets whose padded width reaches this via the memoryless
+    #: 'fused' correlation flavor (BucketKey.impl — the per-bucket program
+    #: swap for widths whose B*H*W^2 reg volume would not fit). 0 = off:
+    #: every bucket keeps the server config's corr_implementation.
+    fused_width: int = 0
 
 
 @dataclasses.dataclass
@@ -372,7 +377,8 @@ class StereoServer:
             it, policy = self._bucket_plan(
                 bh, bw, int(iters or self.serve.default_iters))
             for b in batch_sizes:
-                keys.append(BucketKey(bh, bw, int(b), it, warm, policy))
+                keys.append(BucketKey(bh, bw, int(b), it, warm, policy,
+                                      self._bucket_impl(bw)))
         return self.cache.warmup(keys)
 
     # --- scheduler internals -------------------------------------------------
@@ -391,10 +397,19 @@ class StereoServer:
             return iters, ""
         return min(int(iters), int(entry["budget"])), self.cache.policy_digest
 
+    def _bucket_impl(self, bw: int) -> str:
+        """Correlation-impl flavor for a padded bucket width: '' keeps the
+        server config's implementation; wide buckets past ``fused_width``
+        ride the memoryless 'fused' program (zero volume residency)."""
+        fw = int(getattr(self.serve, "fused_width", 0) or 0)
+        if fw and bw >= fw and self.cfg.corr_implementation != "fused":
+            return "fused"
+        return ""
+
     def _group_key(self, req: _Request) -> Tuple:
         bh, bw = self._bucket_shape(*req.image1.shape[:2])
         iters, policy = self._bucket_plan(bh, bw, req.iters)
-        return (bh, bw, iters, req.warm, policy)
+        return (bh, bw, iters, req.warm, policy, self._bucket_impl(bw))
 
     def _collect(self, first: _Request) -> List[_Request]:
         first.t_collect = first.t_collect or time.perf_counter()
@@ -432,8 +447,8 @@ class StereoServer:
         return np.zeros(shape, np.float32)
 
     def _dispatch(self, group: List[_Request]) -> None:
-        bh, bw, iters, warm, policy = self._group_key(group[0])
-        key = BucketKey(bh, bw, len(group), iters, warm, policy)
+        bh, bw, iters, warm, policy, impl = self._group_key(group[0])
+        key = BucketKey(bh, bw, len(group), iters, warm, policy, impl)
         padders = []
         im1, im2, inits = [], [], []
         t0 = time.perf_counter()

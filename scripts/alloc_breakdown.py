@@ -13,18 +13,27 @@ allocations by size and, inside the dominant temp allocation, the largest
 HLO values (instruction + shape) — the concrete buffer a spill claim must
 name.
 
-Artifacts under ``--out`` (default ``runs/alloc_b<batch>_<schedule>``):
+Every run also reports the CORRELATION-VOLUME CLASS (obs/xla.py
+volume_class_summary): count and bytes of values shaped like the all-pairs
+volume pyramid, ``(..., W1, W2_level)`` spanning at least the feature-map
+height. ``--ab`` compiles the same recipe under ``reg`` and ``fused`` and
+diffs the class — the r18 proof that the memoryless kernel leaves the class
+EMPTY (count 0), not merely smaller.
 
-* ``analysis.json`` — config, compile result, memory_analysis totals, and
-  the named breakdown;
+Artifacts under ``--out`` (default ``runs/alloc_b<batch>_<schedule>``;
+``runs/alloc_fused_b<batch>_<schedule>`` when --corr_implementation=fused):
+
+* ``analysis.json`` — config, compile result, memory_analysis totals, the
+  named breakdown, and the volume-class summary;
 * ``events.jsonl`` — the child's xla_memory/xla_cost introspection events
   (``BENCH_RUN_DIR`` is pointed at the artifact dir);
 * ``memory-usage-report.txt`` — XLA's own sorted-allocation report, kept
   verbatim (the raw dump is pruned unless ``--keep-dump``: the optimized-
   HLO text for the flagship graph runs to hundreds of MB).
+* with ``--ab``: the two runs' dirs plus ``compare.json`` next to them.
 
 Run: python scripts/alloc_breakdown.py --batch 10 --schedule frugal
-     [--h 320 --w 720] [--timeout 1500]
+     [--h 320 --w 720] [--timeout 1500] [--corr_implementation fused] [--ab]
 """
 
 import argparse
@@ -40,7 +49,8 @@ from bench import (  # noqa: E402  (no jax at module level)
     FLAGSHIP_RECIPE, run_attempt_subprocess_detailed)
 from raft_stereo_tpu.config import R4_BEST_SCHEDULE  # noqa: E402
 from raft_stereo_tpu.obs.xla import (  # noqa: E402
-    find_buffer_assignment, summarize_buffer_assignment)
+    find_buffer_assignment, summarize_buffer_assignment,
+    volume_class_summary)
 
 SCHEDULES = {
     # the bench banker: hi-res-only block remat + the r4 best schedule
@@ -51,32 +61,20 @@ SCHEDULES = {
     "monolith": dict(**R4_BEST_SCHEDULE),
 }
 
+# feature maps run at 1/4 resolution (n_downsample=2) in every shipped recipe
+_FEAT_FACTOR = 4
 
-def main():
-    p = argparse.ArgumentParser()
-    p.add_argument("--batch", type=int, default=10)
-    p.add_argument("--schedule", choices=sorted(SCHEDULES), default="frugal")
-    p.add_argument("--dtype", choices=["bfloat16", "float32"],
-                   default="bfloat16")
-    p.add_argument("--h", type=int, default=FLAGSHIP_RECIPE["h"])
-    p.add_argument("--w", type=int, default=FLAGSHIP_RECIPE["w"])
-    p.add_argument("--train_iters", type=int,
-                   default=FLAGSHIP_RECIPE["train_iters"])
-    p.add_argument("--timeout", type=float, default=1500.0)
-    p.add_argument("--out", default=None)
-    p.add_argument("--top", type=int, default=10)
-    p.add_argument("--keep-dump", action="store_true")
-    args = p.parse_args()
 
-    out = args.out or os.path.join(
-        REPO, "runs", f"alloc_b{args.batch}_{args.schedule}")
+def run_one(args, impl, out):
+    """Compile one (schedule, corr impl) recipe; write its artifact dir and
+    return the analysis report dict."""
     dump_dir = os.path.join(out, "xla_dump")
     os.makedirs(dump_dir, exist_ok=True)
 
     kw = dict(batch=args.batch, h=args.h, w=args.w,
               train_iters=args.train_iters, steps=1, fused_loss=True,
-              corr_storage_dtype=args.dtype, compile_only=True,
-              **SCHEDULES[args.schedule])
+              corr_storage_dtype=args.dtype, corr_implementation=impl,
+              compile_only=True, **SCHEDULES[args.schedule])
 
     # the child inherits env: route the dump + the introspection events to
     # the artifact dir; restore afterwards so nothing leaks into later use
@@ -99,9 +97,13 @@ def main():
 
     ba_path = find_buffer_assignment(dump_dir)
     breakdown = None
+    vol_class = None
     if ba_path is not None:
         with open(ba_path) as f:
-            breakdown = summarize_buffer_assignment(f.read(), top=args.top)
+            text = f.read()
+        breakdown = summarize_buffer_assignment(text, top=args.top)
+        vol_class = volume_class_summary(
+            text, w1=args.w // _FEAT_FACTOR, h1=args.h // _FEAT_FACTOR)
     report = {
         "config": kw,
         "ok": result is not None,
@@ -111,6 +113,7 @@ def main():
         "error": None if err is None else err[:400],
         "wall_s": round(wall, 1),
         "buffer_assignment": breakdown,
+        "volume_class": vol_class,
     }
     with open(os.path.join(out, "analysis.json"), "w") as f:
         json.dump(report, f, indent=1)
@@ -126,16 +129,20 @@ def main():
                         os.path.join(out, "memory-usage-report.txt"))
     if not args.keep_dump:
         shutil.rmtree(dump_dir, ignore_errors=True)
+    return report
 
+
+def _print_report(args, impl, out, report):
+    breakdown = report["buffer_assignment"]
     if breakdown is None:
-        print(f"no buffer-assignment dump captured "
+        print(f"[{impl}] no buffer-assignment dump captured "
               f"(error: {report['error']})", file=sys.stderr)
         print(json.dumps({k: report[k] for k in
                           ("ok", "compile_s", "error", "wall_s")}))
-        return 1
+        return False
     gib = 1024 ** 3
     dom = breakdown["dominant_temp"]
-    print(f"b{args.batch} {args.schedule} ({args.dtype}) "
+    print(f"b{args.batch} {args.schedule} ({args.dtype}, {impl}) "
           f"{args.h}x{args.w}x{args.train_iters}it — "
           f"total {breakdown['total_bytes'] / gib:.2f} GiB, "
           f"temps {breakdown['temp_bytes'] / gib:.2f} GiB")
@@ -145,8 +152,88 @@ def main():
         for v in dom["top_values"]:
             print(f"  {v['size'] / gib:8.3f} GiB  {v['shape']:28s} "
                   f"{v['instruction'][:70]}")
+    vc = report["volume_class"]
+    if vc is not None:
+        print(f"volume class (trailing ({vc['w1']}, {vc['pool_widths']}), "
+              f">= {vc['h1']} rows): {vc['count']} values, "
+              f"{vc['bytes'] / gib:.3f} GiB")
     print(f"artifact: {out}/analysis.json")
-    return 0
+    return True
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=10)
+    p.add_argument("--schedule", choices=sorted(SCHEDULES), default="frugal")
+    p.add_argument("--dtype", choices=["bfloat16", "float32"],
+                   default="bfloat16")
+    p.add_argument("--corr_implementation", default="reg",
+                   choices=["reg", "alt", "reg_pallas", "alt_pallas",
+                            "fused"])
+    p.add_argument("--ab", action="store_true",
+                   help="compile BOTH reg and fused at this recipe and diff "
+                        "the volume allocation class (the r18 memoryless "
+                        "proof)")
+    p.add_argument("--h", type=int, default=FLAGSHIP_RECIPE["h"])
+    p.add_argument("--w", type=int, default=FLAGSHIP_RECIPE["w"])
+    p.add_argument("--train_iters", type=int,
+                   default=FLAGSHIP_RECIPE["train_iters"])
+    p.add_argument("--timeout", type=float, default=1500.0)
+    p.add_argument("--out", default=None)
+    p.add_argument("--top", type=int, default=10)
+    p.add_argument("--keep-dump", action="store_true")
+    args = p.parse_args()
+
+    def default_out(impl):
+        prefix = "alloc_fused" if impl == "fused" else "alloc"
+        return os.path.join(
+            REPO, "runs", f"{prefix}_b{args.batch}_{args.schedule}")
+
+    if not args.ab:
+        impl = args.corr_implementation
+        out = args.out or default_out(impl)
+        report = run_one(args, impl, out)
+        return 0 if _print_report(args, impl, out, report) else 1
+
+    # --ab: the named-class comparison. Same batch/shape/schedule, two
+    # compiles; the claim under test is count_fused == 0 while reg's class
+    # carries the pyramid.
+    reports = {}
+    ok = True
+    for impl in ("reg", "fused"):
+        out = default_out(impl)
+        reports[impl] = (out, run_one(args, impl, out))
+        ok = _print_report(args, impl, out, reports[impl][1]) and ok
+    gib = 1024 ** 3
+    compare = {"batch": args.batch, "schedule": args.schedule,
+               "dtype": args.dtype,
+               "shape": [args.h, args.w, args.train_iters]}
+    for impl, (out, rep) in reports.items():
+        vc = rep["volume_class"] or {}
+        xla = rep["xla"] or {}
+        compare[impl] = {
+            "volume_class_count": vc.get("count"),
+            "volume_class_bytes": vc.get("bytes"),
+            "peak_bytes": xla.get("peak_bytes"),
+            "temp_bytes": xla.get("temp_bytes"),
+            "artifact": out,
+        }
+    vc_fused = (reports["fused"][1].get("volume_class") or {})
+    vc_reg = (reports["reg"][1].get("volume_class") or {})
+    compare["volume_class_gone"] = (vc_fused.get("count") == 0
+                                    and (vc_reg.get("count") or 0) > 0)
+    cmp_path = os.path.join(
+        REPO, "runs", f"alloc_fused_ab_b{args.batch}_{args.schedule}.json")
+    with open(cmp_path, "w") as f:
+        json.dump(compare, f, indent=1)
+    if vc_reg and vc_fused:
+        print(f"volume class: reg {vc_reg['count']} values "
+              f"({(vc_reg['bytes'] or 0) / gib:.3f} GiB) -> fused "
+              f"{vc_fused['count']} values "
+              f"({(vc_fused['bytes'] or 0) / gib:.3f} GiB); "
+              f"gone={compare['volume_class_gone']}")
+    print(f"comparison: {cmp_path}")
+    return 0 if (ok and compare["volume_class_gone"]) else 1
 
 
 if __name__ == "__main__":

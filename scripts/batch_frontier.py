@@ -21,12 +21,19 @@ LOGGED kwarg on every row (``--dtypes``, default bfloat16), and passing
 ``--dtypes bfloat16 float32`` adds the fp32 contrast rows that bound what
 the bf16 volume is actually buying at each batch.
 
+Correlation implementation (r18): the ``fused`` rung reruns each batch's
+ladder with the memoryless W2-blocked lookup (``--impls reg fused``,
+default) — the b10-b16 rungs the materialized volume closed are exactly
+what deleting its allocation class should reopen, so every row now logs
+``corr_implementation`` and the fused rows ladder the same three schedules.
+``--impls reg`` restores the pre-r18 ladder byte-for-byte.
+
 Results append to runs/batch_frontier.log as dated JSON lines; attempts run
 through bench.py's locked subprocess runner so they serialize with the
 monolith prober and any driver bench run.
 
 Run: python scripts/batch_frontier.py [--batches 10 12 16]
-     [--dtypes bfloat16 float32]
+     [--dtypes bfloat16 float32] [--impls reg fused]
 """
 
 import argparse
@@ -55,6 +62,13 @@ def main():
                    choices=["bfloat16", "float32"],
                    help="corr-volume storage dtypes to ladder (bf16 is the "
                         "bench default; float32 adds the contrast row)")
+    p.add_argument("--impls", nargs="+", default=["reg", "fused"],
+                   choices=["reg", "fused", "alt", "reg_pallas",
+                            "alt_pallas"],
+                   help="correlation implementations to ladder: 'fused' is "
+                        "the r18 memoryless rung (no B*H*W^2 volume class) "
+                        "probing whether b10-b16 reopen; 'reg' alone "
+                        "restores the pre-r18 ladder")
     p.add_argument("--timeout", type=float, default=1500.0)
     args = p.parse_args()
 
@@ -73,36 +87,40 @@ def main():
     best = None
     for b in args.batches:
         for dtype in args.dtypes:
-            for name, sched in (("banker", banker),
-                                ("hires_frugal", hires_frugal),
-                                ("frugal", frugal)):
-                kw = dict(batch=b, corr_storage_dtype=dtype, **sched,
-                          **RECIPE)
-                result, err, wall = run_attempt_subprocess_detailed(
-                    kw, args.timeout)
-                # the attempt's compiled-artifact introspection (bench.py
-                # AOT path, obs/xla.py) rides every row: peak/temp bytes
-                # say WHY a batch stops fitting, flops/byte whether the
-                # ladder left the compute-bound regime
-                xla = (result or {}).get("xla") or {}
-                _log({"batch": b, "schedule": name,
-                      "corr_storage_dtype": dtype,
-                      "ok": result is not None,
-                      "pairs_per_sec":
-                          None if result is None else result["value"],
-                      "xla_peak_bytes": xla.get("peak_bytes"),
-                      "xla_temp_bytes": xla.get("temp_bytes"),
-                      "xla_flops_per_byte": xla.get("flops_per_byte"),
-                      "error": None if err is None else err[:300],
-                      "wall_s": round(wall, 1)})
-                if result is not None:
-                    if best is None or result["value"] > best[3]:
-                        best = (b, name, dtype, result["value"])
-                    break  # heaviest fitting schedule wins; skip lighter ones
+            for impl in args.impls:
+                for name, sched in (("banker", banker),
+                                    ("hires_frugal", hires_frugal),
+                                    ("frugal", frugal)):
+                    kw = dict(batch=b, corr_storage_dtype=dtype,
+                              corr_implementation=impl, **sched, **RECIPE)
+                    result, err, wall = run_attempt_subprocess_detailed(
+                        kw, args.timeout)
+                    # the attempt's compiled-artifact introspection
+                    # (bench.py AOT path, obs/xla.py) rides every row:
+                    # peak/temp bytes say WHY a batch stops fitting,
+                    # flops/byte whether the ladder left the compute-bound
+                    # regime
+                    xla = (result or {}).get("xla") or {}
+                    _log({"batch": b, "schedule": name,
+                          "corr_storage_dtype": dtype,
+                          "corr_implementation": impl,
+                          "ok": result is not None,
+                          "pairs_per_sec":
+                              None if result is None else result["value"],
+                          "xla_peak_bytes": xla.get("peak_bytes"),
+                          "xla_temp_bytes": xla.get("temp_bytes"),
+                          "xla_flops_per_byte": xla.get("flops_per_byte"),
+                          "error": None if err is None else err[:300],
+                          "wall_s": round(wall, 1)})
+                    if result is not None:
+                        if best is None or result["value"] > best[4]:
+                            best = (b, name, dtype, impl, result["value"])
+                        break  # heaviest fitting schedule wins per impl
     _log({"done": True,
           "best": None if best is None else
           {"batch": best[0], "schedule": best[1],
-           "corr_storage_dtype": best[2], "pairs_per_sec": best[3]}})
+           "corr_storage_dtype": best[2], "corr_implementation": best[3],
+           "pairs_per_sec": best[4]}})
     return 0
 
 

@@ -33,6 +33,13 @@ rehearsal:
   before round end; a throughput regression in the same path is what the
   compare leg gates (the bench chain's scan A/B attempt writes into
   ``runs/bench/current``).
+* **fusedcorr** — the memoryless fused-correlation leg (r18): run the
+  fused-vs-reg parity, custom-VJP and serve-flavor tests
+  (tests/test_fused_corr.py, forced onto ``JAX_PLATFORMS=cpu``) so a
+  kernel regression in the W2-blocked lookup — the impl whose whole value
+  is deleting the volume allocation class — surfaces before round end;
+  the residency claim itself is gated by the fingerprint leg's
+  ``inference[wide]``/``inference[fused]`` peak-bytes pair.
 * **lint** — graftlint (r9): ``python -m raft_stereo_tpu.cli lint`` under
   ``JAX_PLATFORMS=cpu`` — the jaxpr/compiled-artifact contract rules
   (wgrad placement, dtype policy, donation, host-sync, carry/constant
@@ -243,14 +250,15 @@ def main(argv=None):
                     "driver's budgets (see module doc)")
     p.add_argument("--legs", nargs="+",
                    default=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace", "converge", "numerics",
-                            "fleet"],
+                            "scangrad", "fusedcorr", "lint", "fingerprint",
+                            "fault", "serve", "trace", "converge",
+                            "numerics", "fleet"],
                    choices=["bench", "multichip", "events", "compare",
-                            "scangrad", "lint", "fingerprint", "fault",
-                            "serve", "trace", "converge", "numerics",
-                            "fleet"])
+                            "scangrad", "fusedcorr", "lint", "fingerprint",
+                            "fault", "serve", "trace", "converge",
+                            "numerics", "fleet"])
     p.add_argument("--scangrad-budget", type=float, default=1800.0)
+    p.add_argument("--fusedcorr-budget", type=float, default=1800.0)
     p.add_argument("--lint-budget", type=float, default=900.0)
     p.add_argument("--fingerprint-budget", type=float, default=900.0)
     p.add_argument("--fault-budget", type=float, default=1800.0)
@@ -298,6 +306,12 @@ def main(argv=None):
             [sys.executable, "-m", "pytest", "tests/test_scan_grad.py",
              "-q", "-m", "not slow", "-p", "no:cacheprovider"],
             args.scangrad_budget, env={"JAX_PLATFORMS": "cpu"}))
+    if "fusedcorr" in args.legs:
+        records.append(run_leg(
+            "fusedcorr",
+            [sys.executable, "-m", "pytest", "tests/test_fused_corr.py",
+             "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+            args.fusedcorr_budget, env={"JAX_PLATFORMS": "cpu"}))
     if "lint" in args.legs:
         records.append(run_leg(
             "lint", [sys.executable, "-m", "raft_stereo_tpu.cli", "lint"],

@@ -143,7 +143,8 @@ def test_real_chain_shape():
     below-par-gated banker second (it must run even when a slow primary
     banked a number), the always-run scan-backward A/B third (r8 — banks
     whichever refinement backward is faster, with the banker as the
-    pinned-off control), then unbanked fallbacks only."""
+    pinned-off control), the always-run fused-corr A/B fourth (r18 —
+    same control), then unbanked fallbacks only."""
     chain = bench._attempt_chain(True)
     assert chain[0]["when"] == "always" and chain[0]["timeout_s"]
     assert chain[1]["when"] == "below_par"
@@ -156,16 +157,22 @@ def test_real_chain_shape():
     # the control (banker) must run BEFORE the A/B so a custom-path
     # regression can never leave the round without the autodiff number
     assert not chain[1]["kw"].get("batched_scan_wgrad")
+    # the fused-corr A/B (r18): always runs, banker schedule, memoryless
+    # lookup — the banker row above is its reg control
+    assert chain[3]["when"] == "always"
+    assert chain[3]["kw"]["corr_implementation"] == "fused"
+    assert chain[3]["kw"]["remat_encoders"] == "blocks_hires"
+    assert not chain[3]["kw"].get("batched_scan_wgrad")
     # the proven full blocks-remat config backs up the banker, below-par
     # gated too (it must get its shot if the banker banks low or fails)
-    assert chain[3]["when"] == "below_par"
-    assert chain[3]["kw"]["remat_encoders"] == "blocks"
-    # the r4-measured best schedule is on the primary, bankers, and A/B
-    for att in chain[:4]:
+    assert chain[4]["when"] == "below_par"
+    assert chain[4]["kw"]["remat_encoders"] == "blocks"
+    # the r4-measured best schedule is on the primary, bankers, and A/Bs
+    for att in chain[:5]:
         assert att["kw"]["remat_loss_tail"] is False
         assert att["kw"]["fold_enc_saves"] is False
         assert att["kw"]["upsample_tile_budget"] > 10 ** 9
-    assert all(a["when"] == "unbanked" for a in chain[4:])
+    assert all(a["when"] == "unbanked" for a in chain[5:])
     # the split-step attempt is gone (helper-rejected at b8 in r3 AND r4)
     assert not any(a["kw"].get("split_step") for a in chain)
     # every attempt is the SceneFlow recipe family

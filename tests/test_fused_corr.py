@@ -1,0 +1,275 @@
+"""The memoryless fused correlation lookup (r18).
+
+The ``fused`` plugin's contract has three legs, each pinned here:
+
+* PARITY — the W2-blocked Pallas kernel (interpreter mode on CPU) matches
+  the ``reg`` materialized-volume oracle through the full registry path,
+  across radii, pyramid depths (including degenerate narrow levels that
+  route through the pure-JAX reference), out-of-range coords, and forced
+  multi-block tilings (block_w < W2, non-dividing);
+* GRADIENTS — the hand-written VJP (which re-derives tap gradients without
+  a forward-saved volume) matches autodiff through the ``alt`` einsum
+  oracle on both feature maps;
+* MEMORYLESSNESS where it is testable on CPU — the scan-carried state
+  pytree is the O(W) feature pyramid (bytes shrink vs reg's volume
+  pyramid once W2 > D), and the serve cache / ring-mesh surfaces compose
+  with the new impl.
+"""
+
+import dataclasses
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_stereo_tpu.config import CORR_ALIASES, RAFTStereoConfig
+from raft_stereo_tpu.ops.corr import corr_lookup, init_corr
+from raft_stereo_tpu.ops.geometry import coords_grid
+from raft_stereo_tpu.ops.pallas.corr_kernels import (
+    _fused_tiles,
+    fused_windowed_corr_pallas,
+)
+from raft_stereo_tpu.ops.sampler import windowed_linear_sample
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    b, h, w, d = 2, 4, 16, 32
+    f1 = jnp.asarray(rng.normal(size=(b, h, w, d)), jnp.float32)
+    f2 = jnp.asarray(rng.normal(size=(b, h, w, d)), jnp.float32)
+    # deliberately past both edges: taps outside [0, W2) must read as zero
+    centers = jnp.asarray(rng.uniform(-4, w + 4, size=(b, h, w)), jnp.float32)
+    return f1, f2, centers
+
+
+def _oracle(f1, f2, centers, radius):
+    d = f1.shape[-1]
+    vol = jnp.einsum("bhwd,bhvd->bhwv", f1, f2) / jnp.sqrt(jnp.float32(d))
+    return windowed_linear_sample(vol, centers, radius)
+
+
+class TestFusedKernel:
+    @pytest.mark.parametrize("radius", [1, 3, 4])
+    def test_forward_matches_oracle(self, data, radius):
+        f1, f2, centers = data
+        want = _oracle(f1, f2, centers, radius)
+        got = fused_windowed_corr_pallas(f1, f2, centers, radius)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_forward_multiblock(self, data):
+        """block_w < W2 forces nv > 1 (here a NON-dividing tile, so the
+        zero-padded tail block is exercised too) — cross-block window
+        accumulation must stay exact."""
+        f1, f2, centers = data
+        w2 = f2.shape[2]
+        k = 2 * 3 + 1
+        tiles = _fused_tiles(f1.shape[1], f1.shape[2], w2, f1.shape[3],
+                             k, block_w=9)
+        assert tiles is not None and tiles[2] > 1 and tiles[3] > w2
+        want = _oracle(f1, f2, centers, 3)
+        got = fused_windowed_corr_pallas(f1, f2, centers, 3, 9)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_degenerate_narrow_w2(self, data):
+        """W2 <= 2r+2 lanes: the blocked kernel cannot tile, the pure-JAX
+        per-tap reference must carry the level with identical semantics."""
+        f1, f2, centers = data
+        f2n = f2[:, :, :6]
+        assert _fused_tiles(f1.shape[1], f1.shape[2], 6, f1.shape[3],
+                            2 * 4 + 1, 256) is None
+        want = _oracle(f1, f2n, centers, 4)
+        got = fused_windowed_corr_pallas(f1, f2n, centers, 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    @pytest.mark.parametrize("block_w", [256, 9])
+    def test_backward_matches_oracle(self, data, block_w):
+        """The hand VJP (no forward-saved volume) vs autodiff through the
+        einsum oracle, on both the single- and multi-block tilings."""
+        f1, f2, centers = data
+        rng = np.random.default_rng(2)
+        ct = jnp.asarray(rng.normal(size=(2, 4, 16, 7)), jnp.float32)
+
+        def fused(a, b):
+            return jnp.sum(
+                fused_windowed_corr_pallas(a, b, centers, 3, block_w) * ct)
+
+        def oracle(a, b):
+            return jnp.sum(_oracle(a, b, centers, 3) * ct)
+
+        g_f = jax.grad(fused, argnums=(0, 1))(f1, f2)
+        g_o = jax.grad(oracle, argnums=(0, 1))(f1, f2)
+        for a, b in zip(g_f, g_o):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+
+class TestRegistryParity:
+    @pytest.mark.parametrize("radius,num_levels", [(1, 2), (3, 2), (4, 4)])
+    def test_lookup_matches_reg(self, data, radius, num_levels):
+        # num_levels=4 pools W2 down to 2 — the deepest levels run the
+        # degenerate reference path inside a registry lookup
+        f1, f2, _ = data
+        b, h, w, _ = f1.shape
+        coords = coords_grid(b, h, w) + 1.3
+        want = corr_lookup(init_corr("reg", f1, f2, num_levels=num_levels,
+                                     radius=radius), coords)
+        got = corr_lookup(init_corr("fused", f1, f2, num_levels=num_levels,
+                                    radius=radius), coords)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_lookup_matches_reg_multiblock(self, data):
+        f1, f2, _ = data
+        b, h, w, _ = f1.shape
+        coords = coords_grid(b, h, w) + 1.3
+        want = corr_lookup(init_corr("reg", f1, f2, num_levels=2, radius=3),
+                           coords)
+        got = corr_lookup(init_corr("fused", f1, f2, num_levels=2, radius=3,
+                                    block_w=9), coords)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_grad_matches_alt_autodiff(self, data):
+        """End-to-end registry gradients: the fused custom VJP vs plain
+        autodiff through the alt (einsum + windowed sample) lookup."""
+        f1, f2, _ = data
+        b, h, w, _ = f1.shape
+        coords = coords_grid(b, h, w) + 1.3
+        rng = np.random.default_rng(3)
+        ct = jnp.asarray(rng.normal(size=(b, h, w, 2 * 7)), jnp.float32)
+
+        def loss(impl):
+            def f(a, b2):
+                state = init_corr(impl, a, b2, num_levels=2, radius=3)
+                return jnp.sum(corr_lookup(state, coords) * ct)
+            return jax.grad(f, argnums=(0, 1))(f1, f2)
+
+        for a, b2 in zip(loss("fused"), loss("alt")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_state_is_feature_pyramid_and_shrinks(self):
+        """The scan carry: fused state must be the O(W) feature pyramid
+        (alt-shaped, last dim D), strictly smaller than reg's volume
+        pyramid once W2 > D — the whole point of the impl."""
+        rng = np.random.default_rng(4)
+        b, h, w, d = 1, 4, 512, 32
+        f1 = jnp.asarray(rng.normal(size=(b, h, w, d)), jnp.float32)
+        f2 = jnp.asarray(rng.normal(size=(b, h, w, d)), jnp.float32)
+
+        def leaf_bytes(state):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree_util.tree_leaves(state))
+
+        fused = init_corr("fused", f1, f2, num_levels=4, radius=4)
+        reg = init_corr("reg", f1, f2, num_levels=4, radius=4)
+        assert all(lvl.shape[-1] == d for lvl in fused.levels)
+        assert fused.fmap1 is not None
+        # reg carries ~1.875*H*W*W fp32; fused carries ~2.875*H*W*D
+        assert leaf_bytes(fused) * 4 < leaf_bytes(reg)
+
+    def test_aliases_and_unknown_impl_error(self):
+        for alias in ("alt_cuda", "fused_cuda", "memoryless"):
+            assert CORR_ALIASES[alias] == "fused"
+            cfg = RAFTStereoConfig(corr_implementation=alias)
+            assert cfg.corr_implementation == "fused"
+        with pytest.raises(ValueError) as e:
+            RAFTStereoConfig(corr_implementation="bogus")
+        msg = str(e.value)
+        assert "fused" in msg and "memoryless" in msg and "reg" in msg
+
+    def test_block_w_validation(self):
+        with pytest.raises(ValueError):
+            RAFTStereoConfig(fused_block_w=4)  # < 2r+3 at default radius
+        cfg = RAFTStereoConfig(fused_block_w=16, corr_radius=3)
+        assert cfg.fused_block_w == 16
+
+
+class TestComposition:
+    def test_scan_carry_pytree_matches_alt(self, data):
+        """Inside a scan, the fused state's pytree structure is carried
+        every iteration — it must stay the alt-shaped feature pyramid
+        (no volume leaf can sneak in through the lookup)."""
+        f1, f2, _ = data
+        state = init_corr("fused", f1, f2, num_levels=2, radius=3)
+        alt = init_corr("alt", f1, f2, num_levels=2, radius=3)
+        assert ([x.shape for x in jax.tree_util.tree_leaves(state)]
+                == [x.shape for x in jax.tree_util.tree_leaves(alt)])
+        b, h, w, _ = f1.shape
+        coords = coords_grid(b, h, w) + 1.3
+
+        def body(carry, _):
+            st, c = carry
+            feat = corr_lookup(st, c)
+            c = c + jnp.mean(feat)  # coords move, state is re-carried
+            return (st, c), jnp.mean(feat)
+
+        (_, _), ys = jax.lax.scan(body, (state, coords), None, length=3)
+        assert np.isfinite(np.asarray(ys)).all()
+
+    def test_fused_under_seq_mesh(self, data):
+        """fused needs no collectives: under a seq-sharded mesh (the ring
+        impl's home) it must still trace, run, and match reg."""
+        from raft_stereo_tpu.parallel.mesh import make_mesh
+
+        f1, f2, _ = data
+        b, h, w, _ = f1.shape
+        coords = coords_grid(b, h, w) + 1.3
+        want = corr_lookup(init_corr("reg", f1, f2, num_levels=2, radius=3),
+                           coords)
+        mesh = make_mesh(1, 8)
+        with mesh:
+            got = corr_lookup(init_corr("fused", f1, f2, num_levels=2,
+                                        radius=3), coords)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4)
+
+    def test_bucket_impl_threshold(self):
+        from raft_stereo_tpu.serve.server import ServeConfig, StereoServer
+
+        ns = types.SimpleNamespace(serve=ServeConfig(fused_width=512),
+                                   cfg=RAFTStereoConfig())
+        assert StereoServer._bucket_impl(ns, 512) == "fused"
+        assert StereoServer._bucket_impl(ns, 256) == ""
+        # already-fused server config: no flavor split needed
+        ns.cfg = dataclasses.replace(ns.cfg, corr_implementation="fused")
+        assert StereoServer._bucket_impl(ns, 1024) == ""
+        # off by default
+        ns = types.SimpleNamespace(serve=ServeConfig(),
+                                   cfg=RAFTStereoConfig())
+        assert StereoServer._bucket_impl(ns, 4096) == ""
+
+    def test_serve_cache_fused_flavor(self):
+        """A BucketKey with impl='fused' compiles its own program against
+        the SAME variables and serves finite output close to the reg
+        flavor (fully convolutional model — the impl touches no params)."""
+        from raft_stereo_tpu.models import init_model
+        from raft_stereo_tpu.serve.cache import BucketKey, ExecutableCache
+
+        h, w = 32, 64
+        cfg = RAFTStereoConfig()
+        _, variables = init_model(jax.random.PRNGKey(0), cfg, (1, h, w, 3))
+        cache = ExecutableCache(cfg, variables)
+        rng = np.random.default_rng(5)
+        im1 = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+        im2 = rng.uniform(0, 255, (1, h, w, 3)).astype(np.float32)
+
+        key_reg = BucketKey(h, w, 1, 2, False)
+        key_fused = BucketKey(h, w, 1, 2, False, "", "fused")
+        assert key_fused.label() == f"{h}x{w}b1i2+fused"
+        # the reg key's 5-positional construction stays valid (impl="")
+        assert key_reg.label() == f"{h}x{w}b1i2"
+
+        _, up_reg, finite_reg = cache(key_reg, im1, im2)[:3]
+        _, up_fused, finite_fused = cache(key_fused, im1, im2)[:3]
+        assert bool(finite_reg.all()) and bool(finite_fused.all())
+        assert len(cache) == 2  # two distinct executables, one cache
+        np.testing.assert_allclose(np.asarray(up_fused), np.asarray(up_reg),
+                                   atol=2e-2, rtol=2e-2)

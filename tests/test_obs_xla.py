@@ -18,7 +18,8 @@ from raft_stereo_tpu.obs.xla import (compact_xla_summary, cost_analysis_dict,
                                      introspect_compiled,
                                      memory_analysis_dict,
                                      parse_buffer_assignment,
-                                     summarize_buffer_assignment)
+                                     summarize_buffer_assignment,
+                                     volume_class_summary)
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -188,6 +189,37 @@ def test_parse_buffer_assignment_names_buffers():
     assert dom["allocation"] == 6
     assert dom["top_values"][0]["instruction"] == "dot.4"
     assert dom["top_values"][0]["shape"].startswith("f32[64,64]")
+
+
+_VOLUME_BA_TEXT = """\
+BufferAssignment:
+allocation 0: size 153600, preallocated-temp:
+ value: <1 fusion.1 @0> (size=153600,offset=0): f32[2,24,40,40]{3,2,1,0}
+ value: <2 reduce-window.2 @0> (size=76800,offset=0): f32[2,24,40,20]{3,2,1,0}
+allocation 1: size 76800, preallocated-temp:
+ value: <3 multiply_pad_fusion.4 @0> (size=76800,offset=0): f32[2,24,40,10]{3,2,1,0}
+allocation 2: size 12800, preallocated-temp:
+ value: <4 fused_block.5 @0> (size=12800,offset=0): f32[8,40,10]{2,1,0}
+
+Total bytes used: 243200 (237.5KiB)
+"""
+
+
+def test_volume_class_names_quadratic_levels_only():
+    # The class is the O(H*W^2) residency: the all-pairs volume and its
+    # WIDE pooled descendants.  Two shapes that are NOT in the class share
+    # dims with it: the (2r+2)-lane tap stacks an on-the-fly lookup builds
+    # (trailing 10 collides with pool level 10 -> excluded by the width
+    # floor) and bounded per-block slabs (lead rows < H1).
+    got = volume_class_summary(_VOLUME_BA_TEXT, w1=40, h1=24)
+    assert got["pool_widths"] == [40, 20]
+    assert got["count"] == 2
+    assert got["bytes"] == 153600 + 76800
+    assert all("40,10" not in v["shape"] for v in got["largest"])
+    # lowering the floor re-admits level 2 and catches the tap stack too:
+    # exactly the collision the default floor exists to avoid.
+    loose = volume_class_summary(_VOLUME_BA_TEXT, w1=40, h1=24, min_width=8)
+    assert loose["count"] == 3
 
 
 # --- the regression gate ----------------------------------------------------
