@@ -62,12 +62,18 @@ from raft_stereo_tpu.analysis.findings import Finding
 #: surfaces; v9 adds the memoryless fused-correlation plumbing (r18) —
 #: --fused_block_w and the fused/fused_cuda/memoryless impl choices on
 #: the shared model-config surface, plus --fused_width (the per-bucket
-#: program-swap threshold) on the serve surface.
+#: program-swap threshold) on the serve surface; v10 adds the lint/drill
+#: surfaces (r19) — the graftlint runner's own argparse module
+#: (--concurrency engine selector, --threads-baseline/--witness
+#: lock-order flags) and the load/rehearsal/fleet drill scripts join
+#: ENTRY_SCRIPTS as self-consumed surfaces, and dest= keywords now
+#: override the flag-derived dest (an aliased flag no longer
+#: false-fires).
 RULE_VERSIONS: Dict[str, int] = {
     "tracer-unsafe": 1,
     "wall-clock": 1,
     "import-time-jnp": 1,
-    "cli-drift": 9,
+    "cli-drift": 10,
 }
 
 # Call names (last attribute segment) that trace their function arguments.
@@ -393,6 +399,12 @@ def _argparse_dests(fn: ast.AST) -> Set[str]:
         if not (isinstance(node, ast.Call)
                 and _last_attr(node.func) == "add_argument"):
             continue
+        explicit = next((k.value.value for k in node.keywords
+                         if k.arg == "dest"
+                         and isinstance(k.value, ast.Constant)), None)
+        if explicit is not None:
+            dests.add(explicit)
+            continue
         for a in node.args:
             if isinstance(a, ast.Constant) and isinstance(a.value, str) \
                     and a.value.startswith("--"):
@@ -516,7 +528,14 @@ ENTRY_SURFACES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
 
 #: modules whose own argparse surface must be self-consumed, and whose
 #: config-constructor keywords are checked against the dataclass fields
-ENTRY_SCRIPTS: Tuple[str, ...] = ("bench.py", "scripts/bench_inference.py")
+#: (rule v10 added the graftlint runner — the --concurrency/--witness
+#: engine-4 surface — and the drill/rehearsal scripts)
+ENTRY_SCRIPTS: Tuple[str, ...] = (
+    "bench.py", "scripts/bench_inference.py",
+    "raft_stereo_tpu/analysis/runner.py",
+    "scripts/load_drill.py", "scripts/rehearse_round.py",
+    "scripts/fleet_drill.py",
+)
 
 
 def _parse_file(path: str) -> Optional[ast.Module]:

@@ -1,0 +1,1221 @@
+"""Concurrency lint (graftlint engine 4) over the threaded host runtime.
+
+Engines 1-3 gate the *compiled* programs; this engine gates the host
+threads around them — the serve scheduler, HTTP front, SLO tracker,
+loader producer, stall watchdog, heartbeat daemons, flight recorder and
+signal handlers. It has three parts:
+
+1. A **thread-topology extractor** over the package AST: every
+   ``threading.Thread(target=...)``, ``ThreadPoolExecutor.submit``
+   callback and ``signal.signal`` handler becomes a *thread entry*; every
+   ``self._lock``-style attribute (``Lock``/``RLock``/``Condition``) a
+   *lock object*. Each entry is walked through its statically-resolvable
+   call closure (``self.m()``, ``self.attr.m()`` where the attribute's
+   class is known, module-level and nested functions) carrying the set of
+   locks held at each point, producing per-entry reachable functions,
+   attributes read/written (with the locks guaranteed held at each write)
+   and the static lock-acquisition-order graph from nested ``with lock:``
+   scopes. The map is checked in as ``.graftlint-threads.json`` and
+   ``cli lint --fingerprint`` diffs it like the executable fingerprint:
+   a new thread entry, a new shared attribute or a lock dropped from a
+   path is gated drift until re-banked with ``--update-fingerprint``.
+
+2. **Declarative rules** over that topology (all error severity; the
+   suppression baseline with its ``rule_version`` stamp is the vetting
+   mechanism for the deliberate exceptions):
+
+   * ``shared-write-unlocked`` — an attribute written from >=2 entries
+     with no common lock guaranteed held on at least one write path.
+   * ``lock-order-cycle`` — a cycle in the static acquisition-order
+     graph (lock B acquired while holding A and vice versa).
+   * ``cond-wait-no-predicate`` — ``Condition.wait`` outside a ``while``
+     loop (wakeups are advisory; the predicate must be re-checked).
+   * ``signal-handler-unsafe`` — a signal handler that reachably does
+     I/O, acquires a lock or emits events; the async-signal-safe pattern
+     is flag/Event set only.
+   * ``daemon-no-join`` — a ``daemon=True`` thread whose owning scope
+     has neither a ``.join(...)`` call nor a stop-``Event.set()`` on any
+     path (no drain story at all).
+   * ``queue-timeout-discipline`` — blocking ``get()``/``put(x)``
+     without a timeout inside a loop in a function that is *not* a
+     daemon-thread target (a wedged producer then hangs the process
+     forever instead of failing loud).
+
+3. A **dynamic lock-order witness** (obs/lockwitness.py records actual
+   acquisition orders during the serve/fleet drills): ``check_witness``
+   fails when a witnessed edge contradicts the static order graph or
+   closes a cycle the static pass missed (rule ``lock-order-witness``).
+
+Static boundaries (documented, not silent): reachability follows
+``self.m()``, ``self.<attr>.m()`` when ``self.<attr> = KnownClass(...)``
+is visible in the package, bare calls to module-level and sibling nested
+functions — not arbitrary aliases, higher-order dispatch or cross-process
+hops. Lock holding is modelled from ``with lock:`` scopes only; bare
+``.acquire()`` records an ordering edge but not a held region. ``queue``
+objects and ``threading.Event`` are synchronizers, not shared state.
+Constructor writes (``__init__``/``__post_init__``) happen-before thread
+start and are excluded from the race analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from raft_stereo_tpu.analysis.findings import Finding
+
+TOPOLOGY_VERSION = 1
+
+#: current semantic version per rule (baseline entries record the version
+#: they suppress; a bump flags them stale — findings.apply_baseline).
+RULE_VERSIONS: Dict[str, int] = {
+    "shared-write-unlocked": 1,
+    "lock-order-cycle": 1,
+    "cond-wait-no-predicate": 1,
+    "signal-handler-unsafe": 1,
+    "daemon-no-join": 1,
+    "queue-timeout-discipline": 1,
+    "thread-topology-drift": 1,
+    "lock-order-witness": 1,
+}
+
+CONCURRENCY_RULES = tuple(RULE_VERSIONS)
+
+_LOCK_FACTORIES = {"Lock": "Lock", "RLock": "RLock",
+                   "Condition": "Condition"}
+_EVENT_FACTORIES = {"Event", "Barrier", "Semaphore", "BoundedSemaphore"}
+_QUEUE_FACTORIES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                    "BoundedQueue"}
+
+#: ``self.x.<mutator>(...)`` counts as a write to ``x`` (in-place
+#: mutation of a shared container).
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear",
+})
+
+#: call names that make a signal handler unsafe (I/O, locking, event
+#: emission). ``Event.set`` / plain flag stores are the vetted pattern.
+_HANDLER_EFFECTS = frozenset({
+    "print", "open", "write", "flush", "emit", "log", "warning", "info",
+    "error", "debug", "exception", "acquire", "join", "dump", "put",
+    "get", "notify", "notify_all",
+})
+
+_CTOR_METHODS = frozenset({"__init__", "__post_init__"})
+
+_MAX_WALK_DEPTH = 64
+
+
+def _attr_chain(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _last_attr(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _factory_kind(value: ast.AST, table: Dict[str, str]
+                  ) -> Optional[Tuple[str, ast.Call]]:
+    """('Lock'|'RLock'|'Condition', call node) when ``value`` constructs
+    one — ``threading.Lock()`` or bare ``Lock()``."""
+    if not isinstance(value, ast.Call):
+        return None
+    chain = _attr_chain(value.func)
+    if not chain:
+        return None
+    if chain[-1] in table and (len(chain) == 1 or chain[0] == "threading"):
+        return table[chain[-1]], value
+    return None
+
+
+def _is_factory(value: ast.AST, names: FrozenSet[str] | set) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    chain = _attr_chain(value.func)
+    return bool(chain) and chain[-1] in names
+
+
+# --------------------------------------------------------------- indexing
+
+class _ClassInfo:
+    def __init__(self, rel: str, name: str) -> None:
+        self.rel = rel
+        self.name = name
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.locks: Dict[str, str] = {}        # attr -> kind
+        self.lock_alias: Dict[str, str] = {}   # Condition attr -> base attr
+        self.conds: Set[str] = set()
+        self.events: Set[str] = set()
+        self.queues: Set[str] = set()
+        self.attr_classes: Dict[str, str] = {}  # attr -> class name
+
+    def canonical_lock(self, attr: str) -> Optional[str]:
+        if attr in self.lock_alias:
+            attr = self.lock_alias[attr]
+        if attr in self.locks:
+            return f"{self.rel}::{self.name}.{attr}"
+        return None
+
+
+class _ModuleInfo:
+    def __init__(self, rel: str, tree: ast.Module) -> None:
+        self.rel = rel
+        self.tree = tree
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.mod_locks: Dict[str, str] = {}
+        self.mod_lock_alias: Dict[str, str] = {}
+        self.mod_conds: Set[str] = set()
+        self.mod_events: Set[str] = set()
+        self.mod_queues: Set[str] = set()
+
+    def canonical_mod_lock(self, name: str) -> Optional[str]:
+        if name in self.mod_lock_alias:
+            name = self.mod_lock_alias[name]
+        if name in self.mod_locks:
+            return f"{self.rel}::{name}"
+        return None
+
+
+class _FuncScope:
+    """Locals of one top-level function/method scope (shared, via closure,
+    with its nested defs): locks, events, queues, bound names. ``shared``
+    marks scopes a thread entry actually closes over — only those locals
+    participate in the shared-state analysis (other functions' locals are
+    thread-private)."""
+
+    def __init__(self, rel: str, qual: str) -> None:
+        self.rel = rel
+        self.qual = qual
+        self.shared = False
+        self.locks: Dict[str, str] = {}
+        self.lock_alias: Dict[str, str] = {}
+        self.conds: Set[str] = set()
+        self.events: Set[str] = set()
+        self.queues: Set[str] = set()
+        self.bound: Set[str] = set()
+
+    def canonical_lock(self, name: str) -> Optional[str]:
+        if name in self.lock_alias:
+            name = self.lock_alias[name]
+        if name in self.locks:
+            return f"{self.rel}::{self.qual}.{name}"
+        return None
+
+
+def _index_sync_assign(target_attr: str, value: ast.AST, locks: Dict,
+                       alias: Dict, conds: Set, events: Set, queues: Set
+                       ) -> bool:
+    """Classify one ``<target> = <value>`` against the synchronizer
+    factories; returns True when it was a synchronizer binding."""
+    found = _factory_kind(value, _LOCK_FACTORIES)
+    if found:
+        kind, call = found
+        if kind == "Condition":
+            conds.add(target_attr)
+            base = None
+            if call.args:
+                a0 = call.args[0]
+                if isinstance(a0, ast.Attribute) \
+                        and isinstance(a0.value, ast.Name) \
+                        and a0.value.id == "self":
+                    base = a0.attr
+                elif isinstance(a0, ast.Name):
+                    base = a0.id
+            if base is not None and base in locks:
+                alias[target_attr] = base
+                return True
+        locks[target_attr] = kind
+        return True
+    if _is_factory(value, _EVENT_FACTORIES):
+        events.add(target_attr)
+        return True
+    if _is_factory(value, _QUEUE_FACTORIES):
+        queues.add(target_attr)
+        return True
+    return False
+
+
+def _index_module(rel: str, tree: ast.Module) -> _ModuleInfo:
+    mi = _ModuleInfo(rel, tree)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mi.functions[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            ci = _ClassInfo(rel, node.name)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[sub.name] = sub
+            for meth in ci.methods.values():
+                for stmt in ast.walk(meth):
+                    if isinstance(stmt, ast.Assign) \
+                            and len(stmt.targets) == 1:
+                        t, v = stmt.targets[0], stmt.value
+                    elif isinstance(stmt, ast.AnnAssign) \
+                            and stmt.value is not None:
+                        t, v = stmt.target, stmt.value
+                    else:
+                        continue
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        if not _index_sync_assign(
+                                t.attr, v, ci.locks,
+                                ci.lock_alias, ci.conds, ci.events,
+                                ci.queues):
+                            if isinstance(v, ast.Call):
+                                cn = _last_attr(v.func)
+                                if cn and cn[:1].isupper():
+                                    ci.attr_classes[t.attr] = cn
+            mi.classes[node.name] = ci
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            _index_sync_assign(node.targets[0].id, node.value,
+                               mi.mod_locks, mi.mod_lock_alias,
+                               mi.mod_conds, mi.mod_events, mi.mod_queues)
+    return mi
+
+
+def _func_scope(rel: str, qual: str, fn: ast.AST) -> _FuncScope:
+    sc = _FuncScope(rel, qual)
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            t, v = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            t, v = stmt.target, stmt.value
+        else:
+            continue
+        sc.bound.add(t.id)
+        _index_sync_assign(t.id, v, sc.locks, sc.lock_alias,
+                           sc.conds, sc.events, sc.queues)
+    return sc
+
+
+class _Index:
+    """All modules under the package root, plus a global class registry
+    (class names are unique enough in this package; first wins)."""
+
+    def __init__(self, package_root: str, repo_root: str) -> None:
+        self.modules: Dict[str, _ModuleInfo] = {}
+        self.class_registry: Dict[str, _ClassInfo] = {}
+        for dirpath, dirnames, filenames in os.walk(package_root):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, repo_root)
+                try:
+                    with open(path) as f:
+                        tree = ast.parse(f.read(), filename=rel)
+                except (OSError, SyntaxError):
+                    continue
+                mi = _index_module(rel, tree)
+                self.modules[rel] = mi
+                for cname, ci in mi.classes.items():
+                    self.class_registry.setdefault(cname, ci)
+
+
+# --------------------------------------------------------- entry discovery
+
+class _Entry:
+    def __init__(self, entry_id: str, kind: str, rel: str, target: str,
+                 daemon: bool, line: int,
+                 body: Optional[ast.AST] = None,
+                 cls: Optional[_ClassInfo] = None,
+                 scope: Optional[_FuncScope] = None,
+                 owner: Optional[str] = None) -> None:
+        self.id = entry_id
+        self.kind = kind          # thread | executor | signal | callers
+        self.rel = rel
+        self.target = target
+        self.daemon = daemon
+        self.line = line
+        self.body = body          # FunctionDef/Lambda to walk (None: external)
+        self.cls = cls            # class context for self.*
+        self.scope = scope        # closure scope for Name locals
+        self.owner = owner        # scope key for callers grouping
+        # walk results
+        self.reachable: Set[str] = set()
+        self.locks: Set[str] = set()
+        self.edges: Set[Tuple[str, str]] = set()
+        self.reads: Dict[str, List[FrozenSet[str]]] = {}
+        self.writes: Dict[str, List[FrozenSet[str]]] = {}
+        self.effects: List[str] = []
+
+
+def _creation_sites(mi: _ModuleInfo) -> List[dict]:
+    """Every Thread()/submit()/signal.signal() in the module with its
+    enclosing (class, method-or-function, nested-def) context."""
+    sites: List[dict] = []
+
+    def scan(fn: ast.AST, cls: Optional[str], qual: str,
+             encl: Optional[str]) -> None:
+        nested = {n.name: n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fn}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain and chain[-1] == "Thread" \
+                    and (len(chain) == 1 or chain[0] == "threading"):
+                kw = {k.arg: k.value for k in node.keywords}
+                target = kw.get("target")
+                daemon = kw.get("daemon")
+                sites.append({
+                    "kind": "thread", "cls": cls, "qual": qual,
+                    "nested": nested, "target": target,
+                    "daemon": bool(getattr(daemon, "value", False)),
+                    "line": node.lineno})
+            elif chain and chain[-1] == "submit" and len(chain) >= 2 \
+                    and node.args:
+                sites.append({
+                    "kind": "executor", "cls": cls, "qual": qual,
+                    "nested": nested, "target": node.args[0],
+                    "daemon": False, "line": node.lineno})
+            elif chain == ["signal", "signal"] and len(node.args) >= 2:
+                sites.append({
+                    "kind": "signal", "cls": cls, "qual": qual,
+                    "nested": nested, "target": node.args[1],
+                    "daemon": False, "line": node.lineno})
+
+    for cname, ci in mi.classes.items():
+        for mname, meth in ci.methods.items():
+            scan(meth, cname, f"{cname}.{mname}", None)
+    for fname, fn in mi.functions.items():
+        scan(fn, None, fname, None)
+    return sites
+
+
+def _discover_entries(index: _Index) -> List[_Entry]:
+    entries: List[_Entry] = []
+    seen: Set[str] = set()
+    for rel, mi in sorted(index.modules.items()):
+        for site in _creation_sites(mi):
+            target = site["target"]
+            if target is None:
+                continue
+            cls = mi.classes.get(site["cls"]) if site["cls"] else None
+            body: Optional[ast.AST] = None
+            tqual = None
+            scope: Optional[_FuncScope] = None
+            owner = f"{rel}::{site['cls'] or site['qual']}"
+            if isinstance(target, ast.Attribute) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "self" and cls is not None \
+                    and target.attr in cls.methods:
+                body = cls.methods[target.attr]
+                tqual = f"{cls.name}.{target.attr}"
+            elif isinstance(target, ast.Name):
+                if target.id in site["nested"]:
+                    body = site["nested"][target.id]
+                    tqual = f"{site['qual']}.{target.id}"
+                    scope = _func_scope(
+                        rel, site["qual"],
+                        (cls.methods[site["qual"].split(".", 1)[1]]
+                         if cls is not None else
+                         mi.functions[site["qual"]]))
+                elif target.id in mi.functions:
+                    body = mi.functions[target.id]
+                    tqual = target.id
+            elif isinstance(target, ast.Lambda):
+                body = target
+                tqual = f"{site['qual']}.<lambda>L{target.lineno}"
+            if tqual is None:
+                # external target (httpd.serve_forever): still an entry,
+                # no walkable body
+                tqual = ".".join(_attr_chain(target)) or "<unresolved>"
+            entry_id = f"{rel}::{tqual}[{site['kind']}]"
+            if entry_id in seen:
+                continue
+            seen.add(entry_id)
+            entries.append(_Entry(
+                entry_id, site["kind"], rel, tqual, site["daemon"],
+                site["line"], body=body, cls=cls, scope=scope,
+                owner=owner))
+
+    # callers pseudo-entry per owner scope with >=1 real entry: the code
+    # that runs on *other* threads against the same state (all methods of
+    # the owning class that are not thread targets and not construction;
+    # or the spawning function's own body)
+    by_owner: Dict[str, List[_Entry]] = {}
+    for e in entries:
+        if e.body is not None:
+            by_owner.setdefault(e.owner, []).append(e)
+    for owner, owned in sorted(by_owner.items()):
+        rel = owned[0].rel
+        mi = index.modules[rel]
+        name = owner.split("::", 1)[1]
+        target_names = {e.target for e in owned}
+        if name in mi.classes:
+            ci = mi.classes[name]
+            roots = [(f"{name}.{m}", fn) for m, fn in
+                     sorted(ci.methods.items())
+                     if m not in _CTOR_METHODS
+                     and f"{name}.{m}" not in target_names]
+            if not roots:
+                continue
+            ce = _Entry(f"{rel}::{name}[callers]", "callers", rel, name,
+                        False, 0, cls=ci, owner=owner)
+            ce.roots = roots  # type: ignore[attr-defined]
+            entries.append(ce)
+        elif name in mi.functions:
+            fn = mi.functions[name]
+            ce = _Entry(f"{rel}::{name}[callers]", "callers", rel, name,
+                        False, fn.lineno, body=fn, cls=None,
+                        scope=_func_scope(rel, name, fn), owner=owner)
+            ce.root_is_spawner = True  # type: ignore[attr-defined]
+            entries.append(ce)
+    return entries
+
+
+# ------------------------------------------------------------ entry walks
+
+class _Walker:
+    """Walk one entry's call closure carrying the held-lock set."""
+
+    def __init__(self, index: _Index, entry: _Entry) -> None:
+        self.index = index
+        self.entry = entry
+        self.visited: Set[Tuple[int, FrozenSet[str]]] = set()
+        self.wait_sites: List[Tuple[str, int, bool]] = []
+        self.queue_sites: List[Tuple[str, str, int, bool]] = []
+
+    # -- resolution -------------------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST, ci: Optional[_ClassInfo],
+                      sc: Optional[_FuncScope], mi: _ModuleInfo
+                      ) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and ci is not None:
+            return ci.canonical_lock(expr.attr)
+        if isinstance(expr, ast.Name):
+            if sc is not None:
+                lid = sc.canonical_lock(expr.id)
+                if lid:
+                    return lid
+            return mi.canonical_mod_lock(expr.id)
+        return None
+
+    def _is_cond(self, expr: ast.AST, ci: Optional[_ClassInfo],
+                 sc: Optional[_FuncScope], mi: _ModuleInfo) -> bool:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and ci is not None:
+            return expr.attr in ci.conds
+        if isinstance(expr, ast.Name):
+            return (sc is not None and expr.id in sc.conds) \
+                or expr.id in mi.mod_conds
+        return False
+
+    def _is_queue(self, expr: ast.AST, ci: Optional[_ClassInfo],
+                  sc: Optional[_FuncScope], mi: _ModuleInfo) -> bool:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and ci is not None:
+            return expr.attr in ci.queues
+        if isinstance(expr, ast.Name):
+            return (sc is not None and expr.id in sc.queues) \
+                or expr.id in mi.mod_queues
+        return False
+
+    # -- recording --------------------------------------------------------
+
+    def _record_access(self, space_attr: str, write: bool,
+                       held: FrozenSet[str]) -> None:
+        book = self.entry.writes if write else self.entry.reads
+        book.setdefault(space_attr, []).append(held)
+
+    def _acquire(self, lock_id: str, held: FrozenSet[str]) -> None:
+        self.entry.locks.add(lock_id)
+        if self.entry.kind == "signal":
+            self.entry.effects.append(f"acquire {lock_id}")
+        for h in held:
+            if h != lock_id:
+                self.entry.edges.add((h, lock_id))
+
+    # -- the walk ---------------------------------------------------------
+
+    def walk(self, fn: ast.AST, qual: str, ci: Optional[_ClassInfo],
+             sc: Optional[_FuncScope], mi: _ModuleInfo,
+             held: FrozenSet[str], depth: int = 0,
+             constructing: bool = False) -> None:
+        key = (id(fn), held)
+        if key in self.visited or depth > _MAX_WALK_DEPTH:
+            return
+        self.visited.add(key)
+        self.entry.reachable.add(qual)
+        nested = {n.name: n for n in ast.walk(fn)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fn}
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        nonlocals: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Nonlocal):
+                nonlocals.update(node.names)
+
+        spawner_root = getattr(self.entry, "root_is_spawner", False) \
+            and depth == 0
+
+        def visit(node: ast.AST, held: FrozenSet[str],
+                  in_while: bool, in_loop: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # only entered via call edges
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in node.items:
+                    lid = self._resolve_lock(item.context_expr, ci, sc, mi)
+                    if lid is not None:
+                        self._acquire(lid, new_held)
+                        new_held = new_held | {lid}
+                    else:
+                        visit(item.context_expr, held, in_while, in_loop)
+                for stmt in node.body:
+                    visit(stmt, new_held, in_while, in_loop)
+                return
+            if isinstance(node, ast.While):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held, True, True)
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held, in_while, True)
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    self._store(t, held, qual, ci, sc, mi, nonlocals,
+                                spawner_root, constructing)
+                visit(node.value, held, in_while, in_loop)
+                return
+            if isinstance(node, ast.Call):
+                self._call(node, held, qual, ci, sc, mi, nested, depth,
+                           in_while, in_loop, constructing)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held, in_while, in_loop)
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" and ci is not None \
+                    and isinstance(node.ctx, ast.Load):
+                if node.attr not in ci.locks and node.attr not in ci.conds \
+                        and node.attr not in ci.events \
+                        and node.attr not in ci.queues \
+                        and node.attr not in ci.attr_classes \
+                        and node.attr not in ci.methods:
+                    self._record_access(f"{ci.rel}::{ci.name}.{node.attr}",
+                                        False, held)
+                return
+            if isinstance(node, ast.Name) and sc is not None \
+                    and sc.shared and isinstance(node.ctx, ast.Load) \
+                    and node.id in sc.bound \
+                    and node.id not in sc.locks \
+                    and node.id not in sc.conds \
+                    and node.id not in sc.events \
+                    and node.id not in sc.queues:
+                self._record_access(f"{sc.rel}::{sc.qual}.{node.id}",
+                                    False, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, in_while, in_loop)
+
+        for stmt in body:
+            visit(stmt, held, False, False)
+
+    def _store(self, t: ast.AST, held: FrozenSet[str], qual: str,
+               ci: Optional[_ClassInfo], sc: Optional[_FuncScope],
+               mi: _ModuleInfo, nonlocals: Set[str],
+               spawner_root: bool, constructing: bool) -> None:
+        if constructing:
+            return
+        base = t
+        subscript = False
+        while isinstance(base, ast.Subscript):
+            base = base.value
+            subscript = True
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and ci is not None:
+            if base.attr in ci.locks or base.attr in ci.conds \
+                    or base.attr in ci.events:
+                return
+            self._record_access(f"{ci.rel}::{ci.name}.{base.attr}",
+                                True, held)
+        elif isinstance(base, ast.Name) and sc is not None \
+                and sc.shared and base.id in sc.bound:
+            if base.id in sc.locks or base.id in sc.conds \
+                    or base.id in sc.events:
+                return
+            # in the spawning function's own body a plain rebinding is
+            # (re)creation, which happens-before/after the threads via
+            # start/join; mutations of the shared object still count
+            if spawner_root and not subscript \
+                    and not isinstance(t, ast.Subscript):
+                return
+            if not subscript and not spawner_root \
+                    and base.id not in nonlocals:
+                return  # plain Name store in a thread body = new local
+            self._record_access(f"{sc.rel}::{sc.qual}.{base.id}",
+                                True, held)
+
+    def _call(self, node: ast.Call, held: FrozenSet[str], qual: str,
+              ci: Optional[_ClassInfo], sc: Optional[_FuncScope],
+              mi: _ModuleInfo, nested: Dict[str, ast.AST], depth: int,
+              in_while: bool, in_loop: bool, constructing: bool) -> None:
+        fname = _last_attr(node.func)
+        recv = node.func.value if isinstance(node.func, ast.Attribute) \
+            else None
+
+        # explicit acquire: an ordering edge, not a tracked held region
+        if fname == "acquire" and recv is not None:
+            lid = self._resolve_lock(recv, ci, sc, mi)
+            if lid is not None:
+                self._acquire(lid, held)
+                return
+        # Condition.wait outside a while loop
+        if fname == "wait" and recv is not None \
+                and self._is_cond(recv, ci, sc, mi):
+            self.wait_sites.append((f"{mi.rel}::{qual}", node.lineno,
+                                    in_while))
+        # blocking queue ops without timeout, inside a loop
+        if fname in ("get", "put") and recv is not None \
+                and self._is_queue(recv, ci, sc, mi):
+            has_timeout = any(k.arg == "timeout" for k in node.keywords) \
+                or (fname == "get" and len(node.args) >= 1) \
+                or (fname == "put" and len(node.args) >= 2)
+            if not has_timeout and in_loop:
+                self.queue_sites.append((f"{mi.rel}::{qual}", fname,
+                                         node.lineno, in_loop))
+        # mutator call on shared state = write
+        if fname in _MUTATORS and recv is not None:
+            self._store_recv(recv, held, ci, sc, constructing)
+        # signal-handler effects
+        if self.entry.kind == "signal" and fname in _HANDLER_EFFECTS:
+            self.entry.effects.append(
+                f"{fname}() at {mi.rel}:{node.lineno}")
+        if self.entry.kind == "signal" and isinstance(node.func, ast.Name) \
+                and node.func.id in ("print", "open"):
+            self.entry.effects.append(
+                f"{node.func.id}() at {mi.rel}:{node.lineno}")
+
+        # call edges
+        callee: Optional[Tuple[ast.AST, str, Optional[_ClassInfo],
+                               Optional[_FuncScope], _ModuleInfo]] = None
+        if isinstance(node.func, ast.Attribute):
+            v = node.func.value
+            if isinstance(v, ast.Name) and v.id == "self" \
+                    and ci is not None and fname in ci.methods:
+                callee = (ci.methods[fname], f"{ci.name}.{fname}", ci, sc,
+                          self.index.modules[ci.rel])
+            elif isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self" and ci is not None:
+                # self.<attr>.<m>() with a known attribute class
+                cname = ci.attr_classes.get(v.attr)
+                tci = self.index.class_registry.get(cname) if cname else None
+                if tci is not None and fname in tci.methods:
+                    callee = (tci.methods[fname],
+                              f"{tci.name}.{fname}", tci, None,
+                              self.index.modules[tci.rel])
+        elif isinstance(node.func, ast.Name):
+            if node.func.id in nested:
+                callee = (nested[node.func.id],
+                          f"{qual}.{node.func.id}", ci, sc, mi)
+            elif node.func.id in mi.functions:
+                callee = (mi.functions[node.func.id], node.func.id,
+                          None, None, mi)
+        if callee is not None:
+            cfn, cqual, cci, csc, cmi = callee
+            self.walk(cfn, cqual, cci, csc, cmi, held, depth + 1,
+                      constructing=constructing
+                      or cqual.split(".")[-1] in _CTOR_METHODS)
+
+    def _store_recv(self, recv: ast.AST, held: FrozenSet[str],
+                    ci: Optional[_ClassInfo], sc: Optional[_FuncScope],
+                    constructing: bool) -> None:
+        if constructing:
+            return
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and ci is not None:
+            if recv.attr in ci.locks or recv.attr in ci.conds \
+                    or recv.attr in ci.events or recv.attr in ci.queues:
+                return
+            self._record_access(f"{ci.rel}::{ci.name}.{recv.attr}",
+                                True, held)
+        elif isinstance(recv, ast.Name) and sc is not None \
+                and recv.id in sc.bound:
+            if recv.id in sc.locks or recv.id in sc.conds \
+                    or recv.id in sc.events or recv.id in sc.queues:
+                return
+            self._record_access(f"{sc.rel}::{sc.qual}.{recv.id}",
+                                True, held)
+
+
+def _walk_entries(index: _Index, entries: List[_Entry]
+                  ) -> Tuple[List[_Walker], Set[str]]:
+    walkers: List[_Walker] = []
+    daemon_targets: Set[str] = set()
+    # scopes a thread entry closes over are shared; a root whose qual
+    # matches one reuses it so caller-side and thread-side accesses land
+    # in the same space
+    shared_scopes: Dict[Tuple[str, str], _FuncScope] = {}
+    for e in entries:
+        if e.scope is not None:
+            e.scope.shared = True
+            shared_scopes[(e.rel, e.scope.qual)] = e.scope
+    for e in entries:
+        if e.daemon and e.body is not None:
+            daemon_targets.add(f"{e.rel}::{e.target}")
+        w = _Walker(index, e)
+        mi = index.modules[e.rel]
+        if e.body is not None:
+            sc = e.scope
+            if sc is None:
+                sc = shared_scopes.get((e.rel, e.target)) \
+                    or _func_scope(e.rel, e.target, e.body)
+            w.walk(e.body, e.target, e.cls, sc, mi, frozenset())
+        for root_qual, root_fn in getattr(e, "roots", []):
+            sc = shared_scopes.get((e.rel, root_qual)) \
+                or _func_scope(e.rel, root_qual, root_fn)
+            w.walk(root_fn, root_qual, e.cls, sc, mi, frozenset())
+        walkers.append(w)
+    return walkers, daemon_targets
+
+
+# ------------------------------------------------------------- the rules
+
+def _guard(helds: List[FrozenSet[str]]) -> FrozenSet[str]:
+    """Locks guaranteed held across every one of these access sites."""
+    out: Optional[FrozenSet[str]] = None
+    for h in helds:
+        out = h if out is None else out & h
+    return out if out is not None else frozenset()
+
+
+def _shared_map(entries: List[_Entry]) -> Dict[str, dict]:
+    """attr -> {writers, readers, common_locks} for every attribute
+    touched by >=2 entries with at least one writer."""
+    writers: Dict[str, Dict[str, List[FrozenSet[str]]]] = {}
+    readers: Dict[str, Set[str]] = {}
+    for e in entries:
+        for attr, helds in e.writes.items():
+            writers.setdefault(attr, {})[e.id] = helds
+        for attr in e.reads:
+            readers.setdefault(attr, set()).add(e.id)
+    shared: Dict[str, dict] = {}
+    for attr, per_entry in writers.items():
+        touching = set(per_entry) | readers.get(attr, set())
+        if len(touching) < 2:
+            continue
+        common: Optional[FrozenSet[str]] = None
+        for helds in per_entry.values():
+            g = _guard(helds)
+            common = g if common is None else common & g
+        shared[attr] = {
+            "writers": sorted(per_entry),
+            "readers": sorted(readers.get(attr, set()) - set(per_entry)),
+            "common_locks": sorted(common or frozenset()),
+        }
+    return shared
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Simple cycles in the acquisition-order digraph (DFS, deduped by
+    node set; the graphs here are tiny)."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles: List[List[str]] = []
+    seen_sets: Set[FrozenSet[str]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            on_path: Set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(list(path))
+            elif nxt not in on_path and len(path) < 16:
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def _has_path(edges: Set[Tuple[str, str]], src: str, dst: str) -> bool:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    stack, seen = [src], {src}
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        for nxt in graph.get(n, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+def run_concurrency_rules(package_root: str,
+                          repo_root: Optional[str] = None
+                          ) -> List[Finding]:
+    """All six static rules over the package tree."""
+    repo_root = repo_root or os.path.dirname(package_root)
+    index = _Index(package_root, repo_root)
+    entries = _discover_entries(index)
+    walkers, daemon_targets = _walk_entries(index, entries)
+    findings: List[Finding] = []
+
+    # shared-write-unlocked
+    shared = _shared_map([w.entry for w in walkers])
+    for attr, info in sorted(shared.items()):
+        if len(info["writers"]) >= 2 and not info["common_locks"]:
+            findings.append(Finding(
+                rule="shared-write-unlocked", severity="error",
+                location=attr,
+                message=f"written from {len(info['writers'])} thread "
+                        f"entries ({', '.join(info['writers'])}) with no "
+                        f"common lock guaranteed held on every write path",
+                data={"writers": info["writers"],
+                      "readers": info["readers"]}))
+
+    # lock-order-cycle
+    all_edges: Set[Tuple[str, str]] = set()
+    for w in walkers:
+        all_edges |= w.entry.edges
+    for cyc in _find_cycles(all_edges):
+        findings.append(Finding(
+            rule="lock-order-cycle", severity="error",
+            location="lock-order::" + "->".join(sorted(cyc)),
+            message=f"static acquisition-order cycle: "
+                    f"{' -> '.join(cyc + [cyc[0]])} — two threads taking "
+                    f"these in opposite orders deadlock",
+            data={"cycle": cyc}))
+
+    # cond-wait-no-predicate / queue-timeout-discipline (deduped across
+    # entries reaching the same site)
+    seen_sites: Set[Tuple[str, int]] = set()
+    for w in walkers:
+        for loc, line, in_while in w.wait_sites:
+            if not in_while and (loc, line) not in seen_sites:
+                seen_sites.add((loc, line))
+                findings.append(Finding(
+                    rule="cond-wait-no-predicate", severity="error",
+                    location=loc,
+                    message=f"Condition.wait at line {line} is not inside "
+                            f"a while loop re-checking its predicate — "
+                            f"spurious wakeups and missed notifies race",
+                    data={"line": line}))
+        for loc, op, line, _ in w.queue_sites:
+            if loc in daemon_targets:
+                continue
+            if (loc, line) in seen_sites:
+                continue
+            seen_sites.add((loc, line))
+            findings.append(Finding(
+                rule="queue-timeout-discipline", severity="error",
+                location=loc,
+                message=f"blocking {op}() without timeout inside a loop "
+                        f"at line {line} in a non-daemon context — a "
+                        f"wedged peer hangs the process forever instead "
+                        f"of failing loud",
+                data={"op": op, "line": line}))
+
+    # signal-handler-unsafe
+    for w in walkers:
+        e = w.entry
+        if e.kind == "signal" and e.effects:
+            findings.append(Finding(
+                rule="signal-handler-unsafe", severity="error",
+                location=f"{e.rel}::{e.target}",
+                message=f"signal handler reachably performs "
+                        f"{'; '.join(sorted(set(e.effects))[:4])} — only "
+                        f"flag/Event stores are async-signal-safe",
+                data={"effects": sorted(set(e.effects))}))
+
+    # daemon-no-join: the owning scope must show a drain story
+    findings.extend(_daemon_no_join(index))
+    return findings
+
+
+def _daemon_no_join(index: _Index) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, mi in sorted(index.modules.items()):
+        for site in _creation_sites(mi):
+            if site["kind"] != "thread" or not site["daemon"]:
+                continue
+            scope_node: Optional[ast.AST] = None
+            loc = f"{rel}::{site['qual']}"
+            if site["cls"]:
+                cname = site["cls"]
+                for top in mi.tree.body:
+                    if isinstance(top, ast.ClassDef) and top.name == cname:
+                        scope_node = top
+                        loc = f"{rel}::{cname}"
+                        break
+            else:
+                scope_node = mi.functions.get(site["qual"])
+            if scope_node is None:
+                continue
+            has_drain = False
+            for node in ast.walk(scope_node):
+                if isinstance(node, ast.Call):
+                    la = _last_attr(node.func)
+                    if la == "join" or la == "set":
+                        has_drain = True
+                        break
+            if not has_drain:
+                findings.append(Finding(
+                    rule="daemon-no-join", severity="error",
+                    location=loc,
+                    message=f"daemon thread created at line "
+                            f"{site['line']} but its owning scope has no "
+                            f".join() and no stop-Event .set() — no drain "
+                            f"path; in-flight work dies silently at exit",
+                    data={"line": site["line"]}))
+    return findings
+
+
+# -------------------------------------------------------- topology document
+
+def build_topology(package_root: str,
+                   repo_root: Optional[str] = None) -> Dict[str, Any]:
+    """The checked-in ``.graftlint-threads.json`` document: entries,
+    locks, the static acquisition-order graph and the shared-attribute
+    map, all deterministically sorted."""
+    repo_root = repo_root or os.path.dirname(package_root)
+    index = _Index(package_root, repo_root)
+    entries = _discover_entries(index)
+    walkers, _ = _walk_entries(index, entries)
+
+    locks: Dict[str, str] = {}
+    for rel, mi in sorted(index.modules.items()):
+        for name, kind in sorted(mi.mod_locks.items()):
+            locks[f"{rel}::{name}"] = kind
+        for cname, ci in sorted(mi.classes.items()):
+            for attr, kind in sorted(ci.locks.items()):
+                locks[f"{rel}::{cname}.{attr}"] = kind
+    # function-scope locks surface through the walkers' acquire sets (the
+    # dynamic witness reports the same ids, so they must be "known")
+    for w in walkers:
+        for lid in w.entry.locks:
+            locks.setdefault(lid, "Lock")
+
+    edges: Set[Tuple[str, str]] = set()
+    doc_entries: Dict[str, Any] = {}
+    for w in walkers:
+        e = w.entry
+        edges |= e.edges
+        doc_entries[e.id] = {
+            "kind": e.kind,
+            "daemon": e.daemon,
+            "target": e.target,
+            "reachable": sorted(e.reachable),
+            "locks": sorted(e.locks),
+            "reads": sorted(e.reads),
+            "writes": {a: sorted(_guard(h)) for a, h in
+                       sorted(e.writes.items())},
+        }
+    for e in entries:
+        if e.body is None and not hasattr(e, "roots"):
+            doc_entries.setdefault(e.id, {
+                "kind": e.kind, "daemon": e.daemon, "target": e.target,
+                "reachable": [], "locks": [], "reads": [], "writes": {},
+            })
+
+    return {
+        "version": TOPOLOGY_VERSION,
+        "entries": {k: doc_entries[k] for k in sorted(doc_entries)},
+        "locks": locks,
+        "lock_order": sorted(list(e) for e in edges),
+        "shared": _shared_map([w.entry for w in walkers]),
+    }
+
+
+def load_topology(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != TOPOLOGY_VERSION:
+        raise ValueError(
+            f"thread-topology version {doc.get('version')!r} != "
+            f"{TOPOLOGY_VERSION} — regenerate with "
+            f"`cli lint --update-fingerprint`")
+    return doc
+
+
+def write_topology(path: str, doc: Dict[str, Any]) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _drift(sev: str, loc: str, msg: str, **data: Any) -> Finding:
+    return Finding(rule="thread-topology-drift", severity=sev,
+                   location=f"threads/{loc}", message=msg, data=data)
+
+
+def diff_topology(baseline: Dict[str, Any],
+                  current: Dict[str, Any]) -> List[Finding]:
+    """Gated drift between the checked-in and the current thread
+    topology. New/removed entries, a lock dropped from an entry's path
+    and a new shared attribute are errors (re-bank with
+    ``--update-fingerprint`` after review); everything else is
+    informational context for the review."""
+    fs: List[Finding] = []
+    b_entries = baseline.get("entries", {})
+    c_entries = current.get("entries", {})
+    for eid in sorted(set(c_entries) - set(b_entries)):
+        fs.append(_drift(
+            "error", eid,
+            f"new thread entry {eid} — review its shared state and "
+            f"locks, then re-bank the topology"))
+    for eid in sorted(set(b_entries) - set(c_entries)):
+        fs.append(_drift(
+            "error", eid,
+            f"thread entry {eid} disappeared from the topology — if "
+            f"intentional, re-bank"))
+    for eid in sorted(set(b_entries) & set(c_entries)):
+        b, c = b_entries[eid], c_entries[eid]
+        dropped = sorted(set(b.get("locks", [])) - set(c.get("locks", [])))
+        if dropped:
+            fs.append(_drift(
+                "error", eid,
+                f"lock(s) dropped from {eid}'s path: "
+                f"{', '.join(dropped)} — previously-guarded state may "
+                f"now race", dropped=dropped))
+        added = sorted(set(c.get("locks", [])) - set(b.get("locks", [])))
+        if added:
+            fs.append(_drift(
+                "info", eid,
+                f"{eid} now acquires {', '.join(added)}", added=added))
+        if bool(b.get("daemon")) != bool(c.get("daemon")):
+            fs.append(_drift(
+                "warning", eid,
+                f"{eid} daemon flag changed "
+                f"{b.get('daemon')} -> {c.get('daemon')}"))
+        new_writes = sorted(set(c.get("writes", {}))
+                            - set(b.get("writes", {})))
+        if new_writes:
+            fs.append(_drift(
+                "warning", eid,
+                f"{eid} writes new attribute(s): "
+                f"{', '.join(new_writes)}", attrs=new_writes))
+    b_shared, c_shared = baseline.get("shared", {}), current.get("shared", {})
+    for attr in sorted(set(c_shared) - set(b_shared)):
+        fs.append(_drift(
+            "error", f"shared/{attr}",
+            f"new shared attribute {attr} (written from >=2 entries: "
+            f"{', '.join(c_shared[attr]['writers'])}) — review its "
+            f"locking, then re-bank", info=c_shared[attr]))
+    for attr in sorted(set(b_shared) - set(c_shared)):
+        fs.append(_drift(
+            "info", f"shared/{attr}",
+            f"shared attribute {attr} no longer shared"))
+    b_edges = {tuple(e) for e in baseline.get("lock_order", [])}
+    c_edges = {tuple(e) for e in current.get("lock_order", [])}
+    for a, b2 in sorted(c_edges - b_edges):
+        fs.append(_drift(
+            "warning", f"order/{a}->{b2}",
+            f"new static acquisition-order edge {a} -> {b2}"))
+    for a, b2 in sorted(b_edges - c_edges):
+        fs.append(_drift(
+            "info", f"order/{a}->{b2}",
+            f"acquisition-order edge {a} -> {b2} gone"))
+    return fs
+
+
+# ------------------------------------------------------------ the witness
+
+def load_witness(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def check_witness(topology: Dict[str, Any],
+                  witness: Dict[str, Any]) -> List[Finding]:
+    """Hold the dynamically-witnessed acquisition orders (from
+    obs/lockwitness.py) against the static topology: a witnessed edge
+    that contradicts the static order, or that closes a cycle the static
+    pass missed, is an error; locks the static pass never saw are
+    informational."""
+    fs: List[Finding] = []
+    static_edges = {tuple(e) for e in topology.get("lock_order", [])}
+    witnessed = [(e[0], e[1]) for e in witness.get("edges", [])]
+    known_locks = set(topology.get("locks", {}))
+
+    for a, b in sorted(set(witnessed)):
+        if _has_path(static_edges, b, a):
+            fs.append(Finding(
+                rule="lock-order-witness", severity="error",
+                location=f"witness/{a}->{b}",
+                message=f"witnessed acquisition {a} -> {b} contradicts "
+                        f"the static order ({b} ..-> {a}) — deadlock "
+                        f"window under the drilled interleaving",
+                data={"edge": [a, b]}))
+
+    union = static_edges | set(witnessed)
+    witnessed_set = set(witnessed)
+    for cyc in _find_cycles(union):
+        cyc_edges = set(zip(cyc, cyc[1:] + cyc[:1]))
+        if cyc_edges & witnessed_set \
+                and not all(e in static_edges for e in cyc_edges):
+            loc = "witness-cycle::" + "->".join(sorted(cyc))
+            if any(f.location == loc for f in fs):
+                continue
+            fs.append(Finding(
+                rule="lock-order-witness", severity="error",
+                location=loc,
+                message=f"witnessed acquisitions close a lock-order "
+                        f"cycle the static pass missed: "
+                        f"{' -> '.join(cyc + [cyc[0]])}",
+                data={"cycle": cyc}))
+
+    for lid in sorted({lk for e in witnessed for lk in e} - known_locks):
+        fs.append(Finding(
+            rule="lock-order-witness", severity="info",
+            location=f"witness/{lid}",
+            message=f"witnessed lock {lid} is not in the static "
+                    f"topology (dynamically created, or created outside "
+                    f"the linted package root)"))
+    if not any(f.severity == "error" for f in fs):
+        fs.append(Finding(
+            rule="lock-order-witness", severity="info",
+            location="witness",
+            message=f"witness consistent with the static order: "
+                    f"{len(witness.get('locks', {}))} lock(s), "
+                    f"{len(witnessed)} ordered edge(s)",
+            data={"locks": len(witness.get("locks", {})),
+                  "edges": len(witnessed)}))
+    return fs

@@ -1,7 +1,8 @@
 """graftlint runner: merge all engines, apply the baseline, gate, report.
 
 ``python -m raft_stereo_tpu.cli lint`` runs every engine by default
-(``--ast`` / ``--graph`` / ``--spmd`` restrict the set), holds the merged
+(``--ast`` / ``--graph`` / ``--spmd`` / ``--concurrency`` restrict the
+set), holds the merged
 findings against the checked-in suppression baseline (``.graftlint.json``),
 prints a human report, optionally writes the JSON report and emits one
 schema-v4 ``lint`` event, and exits non-zero when any *unsuppressed
@@ -11,8 +12,9 @@ error-severity* finding remains — the gate scripts/rehearse_round.py's
 ``--fingerprint`` additionally diffs the canonical executables' structural
 fingerprint (conv placement, collective kinds/counts, peak bytes, donation
 pairs — analysis/fingerprint.py) against the checked-in baseline
-(``.graftlint-fingerprint.json``); drift becomes ordinary error findings,
-so the same gate applies. ``--update-baseline`` / ``--update-fingerprint``
+(``.graftlint-fingerprint.json``), and the host thread topology
+(analysis/concurrency_rules.py) against ``.graftlint-threads.json``;
+drift becomes ordinary error findings, so the same gate applies. ``--update-baseline`` / ``--update-fingerprint``
 rewrite the respective baselines from the current state — the escape hatch
 for intentionally accepting a violation or a structural change; the diff
 review is the policy.
@@ -38,7 +40,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 
 def rule_versions(graph: bool = True, ast: bool = True,
                   spmd: bool = True,
-                  fingerprint: bool = True) -> Dict[str, int]:
+                  fingerprint: bool = True,
+                  concurrency: bool = True) -> Dict[str, int]:
     """Current rule id -> semantic version over the selected engines (the
     map baseline entries are validated against)."""
     versions: Dict[str, int] = {}
@@ -58,6 +61,10 @@ def rule_versions(graph: bool = True, ast: bool = True,
         from raft_stereo_tpu.analysis.fingerprint import \
             RULE_VERSIONS as fp_v
         versions.update(fp_v)
+    if concurrency:
+        from raft_stereo_tpu.analysis.concurrency_rules import \
+            RULE_VERSIONS as conc_v
+        versions.update(conc_v)
     return versions
 
 
@@ -66,7 +73,8 @@ def run_lint(graph: bool = True, ast: bool = True, spmd: bool = True,
              thresholds: Optional[Dict[str, int]] = None,
              spmd_thresholds: Optional[Dict[str, int]] = None,
              compile_train: bool = True,
-             collect_targets: bool = False
+             collect_targets: bool = False,
+             concurrency: bool = True
              ) -> Any:
     """Run the selected engines; raw findings (baseline not applied).
 
@@ -80,6 +88,11 @@ def run_lint(graph: bool = True, ast: bool = True, spmd: bool = True,
         from raft_stereo_tpu.analysis.ast_rules import run_ast_rules
         root = package_root or os.path.join(REPO_ROOT, "raft_stereo_tpu")
         findings.extend(run_ast_rules(root))
+    if concurrency:
+        from raft_stereo_tpu.analysis.concurrency_rules import \
+            run_concurrency_rules
+        root = package_root or os.path.join(REPO_ROOT, "raft_stereo_tpu")
+        findings.extend(run_concurrency_rules(root))
     if graph:
         from raft_stereo_tpu.analysis.graph_rules import (build_targets,
                                                           run_graph_rules)
@@ -106,7 +119,8 @@ def run_lint(graph: bool = True, ast: bool = True, spmd: bool = True,
 
 
 def _rules_run(graph: bool, ast: bool, spmd: bool,
-               fingerprint: bool = False) -> List[str]:
+               fingerprint: bool = False,
+               concurrency: bool = False) -> List[str]:
     rules: List[str] = []
     if graph:
         from raft_stereo_tpu.analysis.graph_rules import GRAPH_RULES
@@ -121,6 +135,10 @@ def _rules_run(graph: bool, ast: bool, spmd: bool,
     if fingerprint:
         from raft_stereo_tpu.analysis.fingerprint import RULE
         rules.append(RULE)
+    if concurrency:
+        from raft_stereo_tpu.analysis.concurrency_rules import \
+            CONCURRENCY_RULES
+        rules.extend(CONCURRENCY_RULES)
     return rules
 
 
@@ -177,6 +195,50 @@ def _fingerprint_findings(args, targets: List[Any], partial: bool
                             partial=partial), current
 
 
+def _topology_findings(args) -> Tuple[List[Finding], Optional[Dict]]:
+    """The thread-topology leg of ``--fingerprint``: build the current
+    topology (engine 4's extractor), handle ``--update-fingerprint``, diff
+    against the checked-in map. Returns (findings, current_doc)."""
+    from raft_stereo_tpu.analysis.concurrency_rules import (build_topology,
+                                                            diff_topology,
+                                                            load_topology,
+                                                            write_topology)
+    root = args.package_root or os.path.join(REPO_ROOT, "raft_stereo_tpu")
+    current = build_topology(root)
+    if args.update_fingerprint:
+        write_topology(args.threads_baseline, current)
+        print(f"thread-topology baseline rewritten: "
+              f"{args.threads_baseline} ({len(current['entries'])} "
+              f"entries, {len(current['locks'])} lock(s))")
+        return [], current
+    if not os.path.exists(args.threads_baseline):
+        return [Finding(
+            rule="thread-topology-drift", severity="error",
+            location="threads",
+            message=f"no thread-topology baseline at "
+                    f"{args.threads_baseline} — generate one with "
+                    f"--update-fingerprint and check it in")], current
+    baseline = load_topology(args.threads_baseline)
+    return diff_topology(baseline, current), current
+
+
+def _witness_findings(args) -> List[Finding]:
+    """Hold a dynamic lock-acquisition log (obs/lockwitness.py dump)
+    against the static topology."""
+    from raft_stereo_tpu.analysis.concurrency_rules import (build_topology,
+                                                            check_witness,
+                                                            load_witness)
+    if not os.path.exists(args.witness):
+        return [Finding(
+            rule="lock-order-witness", severity="error",
+            location="witness",
+            message=f"witness log not found: {args.witness} — run the "
+                    f"drill leg with RAFT_LOCK_WITNESS set first")]
+    root = args.package_root or os.path.join(REPO_ROOT, "raft_stereo_tpu")
+    topology = build_topology(root)
+    return check_witness(topology, load_witness(args.witness))
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="raft_stereo_tpu.cli lint",
@@ -192,6 +254,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--spmd", action="store_true",
                    help="run only the SPMD engine (sharded programs on the "
                         "fake 8-device mesh)")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run only the host-thread concurrency engine "
+                        "(thread topology + lock rules over the package "
+                        "AST)")
     p.add_argument("--no-compile", action="store_true",
                    help="skip the AOT compiles (faster; the donation/"
                         "replication rules need executables and are "
@@ -219,6 +285,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="diff this precomputed fingerprint JSON instead of "
                         "lowering anything (test/debug hook; skips every "
                         "engine)")
+    p.add_argument("--threads-baseline",
+                   default=os.path.join(REPO_ROOT,
+                                        ".graftlint-threads.json"),
+                   help="thread-topology baseline path (diffed by "
+                        "--fingerprint, rewritten by --update-fingerprint)")
+    p.add_argument("--witness", default=None,
+                   help="check this dynamic lock-acquisition log "
+                        "(obs/lockwitness.py dump) against the static "
+                        "thread topology")
     p.add_argument("--json", dest="json_out", default=None,
                    help="write the full JSON report here")
     p.add_argument("--run_dir", default=None,
@@ -229,14 +304,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "raft_stereo_tpu/ (fixture trees in tests)")
     args = p.parse_args(argv)
 
-    any_engine_flag = args.graph or args.ast or args.spmd
+    any_engine_flag = (args.graph or args.ast or args.spmd
+                       or args.concurrency)
     graph = args.graph or not any_engine_flag
     ast_on = args.ast or not any_engine_flag
     spmd_on = args.spmd or not any_engine_flag
+    conc_on = args.concurrency or not any_engine_flag
     fingerprint_on = (args.fingerprint or args.update_fingerprint
                       or bool(args.fingerprint_current))
     if args.fingerprint_current:
-        graph = ast_on = spmd_on = False
+        graph = ast_on = spmd_on = conc_on = False
 
     # the SPMD engine needs its virtual devices BEFORE any engine first
     # imports jax (backends initialize once per process)
@@ -246,11 +323,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         spmd_ready = ensure_host_devices()
 
     findings, targets = run_lint(
-        graph=graph, ast=ast_on, spmd=spmd_on,
+        graph=graph, ast=ast_on, spmd=spmd_on, concurrency=conc_on,
         package_root=args.package_root,
         compile_train=not args.no_compile, collect_targets=True)
 
     fp_doc = None
+    topo_doc = None
     if fingerprint_on:
         # a fingerprint over a subset of engines/compiles must not read a
         # baseline-only target's absence as drift
@@ -258,8 +336,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             or args.no_compile
         fp_findings, fp_doc = _fingerprint_findings(args, targets, partial)
         findings.extend(fp_findings)
+        if not args.fingerprint_current:
+            # the thread-topology map rides the same gate (the
+            # --fingerprint-current hook diffs executables only)
+            topo_findings, topo_doc = _topology_findings(args)
+            findings.extend(topo_findings)
         if args.update_fingerprint:
             return 0
+    if args.witness:
+        findings.extend(_witness_findings(args))
 
     # staleness is validated against EVERY engine's rule map, not just the
     # selected ones — a single-engine run must not declare the other
@@ -282,13 +367,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     engines = [e for e, on in (("graph", graph), ("ast", ast_on),
                                ("spmd", spmd_on and spmd_ready),
+                               ("concurrency", conc_on),
                                ("fingerprint", fingerprint_on)) if on]
     report = make_report(findings, _rules_run(graph, ast_on, spmd_on,
-                                              fingerprint_on), engines,
-                         stale_suppressions=stale)
+                                              fingerprint_on, conc_on),
+                         engines, stale_suppressions=stale)
     if fp_doc is not None:
         report["fingerprint"] = {"baseline": args.fingerprint_baseline,
                                  "current": fp_doc}
+    if topo_doc is not None:
+        report["thread_topology"] = {"baseline": args.threads_baseline,
+                                     "entries": len(topo_doc["entries"]),
+                                     "locks": len(topo_doc["locks"]),
+                                     "shared": len(topo_doc["shared"])}
     if args.json_out:
         os.makedirs(os.path.dirname(args.json_out) or ".", exist_ok=True)
         with open(args.json_out, "w") as f:

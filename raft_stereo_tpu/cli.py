@@ -932,6 +932,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     """
     import sys
 
+    # opt-in lock-order witness (RAFT_LOCK_WITNESS=<dump path>): installed
+    # before dispatch so every subcommand's threads are witnessed
+    from raft_stereo_tpu.obs.lockwitness import maybe_install
+    maybe_install()
+
     argv = list(sys.argv[1:] if argv is None else argv)
     commands = ("telemetry", "compare", "lint", "timeline", "doctor",
                 "fleet", "converge", "numerics", "train", "eval", "serve",

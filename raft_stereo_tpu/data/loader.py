@@ -253,7 +253,20 @@ class Loader:
         thread.start()
         try:
             while True:
-                item = out.get()
+                try:
+                    # bounded wait so a silently-dead producer (killed
+                    # executor, interpreter teardown) can't wedge training
+                    # on a forever-blocking get
+                    item = out.get(timeout=5.0)
+                except queue.Empty:
+                    if thread.is_alive():
+                        continue
+                    try:  # item landed between the timeout and the check
+                        item = out.get_nowait()
+                    except queue.Empty:
+                        raise RuntimeError(
+                            "loader producer thread died without delivering "
+                            "a batch or an exception") from None
                 if item is None:
                     break
                 if isinstance(item, Exception):

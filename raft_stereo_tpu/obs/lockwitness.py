@@ -1,0 +1,267 @@
+"""Dynamic lock-order witness (graftlint engine 4's runtime half).
+
+Opt-in via ``RAFT_LOCK_WITNESS=<dump path>``: the threading lock
+factories are patched so every ``threading.Lock()`` / ``RLock()``
+*created from package code* is wrapped in a recording proxy. Each
+acquisition while another witnessed lock is held records an order edge
+(held -> acquired) keyed by the same canonical lock ids the static
+topology uses (``analysis/concurrency_rules.py``), so the dump can be
+held directly against ``.graftlint-threads.json``:
+
+    RAFT_LOCK_WITNESS=/tmp/w.json python -m raft_stereo_tpu.cli loadtest ...
+    python -m raft_stereo_tpu.cli lint --concurrency --witness /tmp/w.json
+
+A witnessed edge that contradicts the static acquisition order — or
+that closes a cycle the static pass missed — fails the lint gate; the
+serve/fleet drills are the interleavings that make the evidence real
+(scripts/load_drill.py's ``witness`` drill banks it under ``runs/``).
+
+Design notes: only *creation* is intercepted, and only for locks whose
+creating frame lives under ``raft_stereo_tpu/`` — stdlib-internal locks
+(logging, queue.Queue's, bare ``Condition()`` backing locks) are never
+wrapped, so the overhead lands exclusively on the package's own
+synchronization. ``Condition(wrapped_lock)`` works unchanged: the
+proxies expose ``_release_save``/``_acquire_restore``/``_is_owned`` so
+``wait()``'s full release/reacquire is witnessed too.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import linecache
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+_SELF = os.path.abspath(__file__)
+
+# the real factories, captured at import — the registry's own mutex and
+# any stdlib use keep going through these
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+ENV_VAR = "RAFT_LOCK_WITNESS"
+WITNESS_VERSION = 1
+
+_ASSIGN_SELF = re.compile(r"\s*self\.(\w+)\s*[:=]")
+_ASSIGN_NAME = re.compile(r"\s*(\w+)\s*[:=]")
+
+
+def _lock_id_from_frame(frame) -> Optional[str]:
+    """Canonical lock id for a factory call frame, or None when the lock
+    was created outside the package (stdlib, tests, third-party)."""
+    path = os.path.abspath(frame.f_code.co_filename)
+    if not path.startswith(_PKG_DIR + os.sep) or path == _SELF:
+        return None
+    rel = os.path.relpath(path, _REPO_ROOT)
+    qual = getattr(frame.f_code, "co_qualname", None)  # 3.11+
+    if qual is not None:
+        qual = qual.replace(".<locals>", "")
+    line = linecache.getline(path, frame.f_lineno)
+    if "Lock(" not in line:
+        # a C-extension (numpy Generator, etc.) creating its own lock
+        # pushes no Python frame, so the call attributes to the package
+        # caller's line; only wrap literal Lock()/RLock() creation sites
+        return None
+    m = _ASSIGN_SELF.match(line)
+    if m:
+        # self._x = threading.Lock() in a method: the owning class, to
+        # match the static `{rel}::{Class}.{attr}` canonical id
+        if qual and "." in qual:
+            cls = qual.rsplit(".", 2)[-2]
+        else:
+            slf = frame.f_locals.get("self")
+            cls = type(slf).__name__ if slf is not None \
+                else frame.f_code.co_name
+        return f"{rel}::{cls}.{m.group(1)}"
+    qual = qual or frame.f_code.co_name
+    m = _ASSIGN_NAME.match(line)
+    if m:
+        if frame.f_code.co_name == "<module>":
+            return f"{rel}::{m.group(1)}"
+        return f"{rel}::{qual}.{m.group(1)}"
+    return f"{rel}::{qual}.L{frame.f_lineno}"
+
+
+class _Registry:
+    """Per-thread held stacks + the global witnessed order-edge counts."""
+
+    def __init__(self) -> None:
+        self._mu = _ORIG_LOCK()
+        self._tls = threading.local()
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.locks: Dict[str, str] = {}
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquire(self, lock_id: str) -> None:
+        held = self._held()
+        if lock_id not in held:  # re-entrant RLock levels add no edge
+            if held:
+                edge = (held[-1], lock_id)
+                with self._mu:
+                    self.edges[edge] = self.edges.get(edge, 0) + 1
+        held.append(lock_id)
+
+    def note_release(self, lock_id: str) -> None:
+        held = self._held()
+        # release order need not mirror acquire order; drop the deepest
+        # occurrence so outer levels keep witnessing
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == lock_id:
+                del held[i]
+                break
+
+    def register(self, lock_id: str, kind: str) -> None:
+        with self._mu:
+            self.locks.setdefault(lock_id, kind)
+
+    def dump(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "version": WITNESS_VERSION,
+                "locks": dict(sorted(self.locks.items())),
+                "edges": sorted([a, b, n] for (a, b), n
+                                in self.edges.items()),
+            }
+
+
+class _LockProxy:
+    """Witnessing wrapper over a primitive lock; Condition-compatible."""
+
+    def __init__(self, inner, lock_id: str, registry: _Registry) -> None:
+        self._inner = inner
+        self._witness_id = lock_id
+        self._reg = registry
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._reg.note_acquire(self._witness_id)
+        return got
+
+    def release(self) -> None:
+        self._reg.note_release(self._witness_id)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._inner!r} as {self._witness_id}>"
+
+    # Condition(lock) protocol: a primitive Lock releases one level
+    def _release_save(self):
+        self.release()
+
+    def _acquire_restore(self, state) -> None:
+        self.acquire()
+
+    def _is_owned(self) -> bool:
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+class _RLockProxy(_LockProxy):
+    """RLock flavor: ``_release_save`` drops ALL levels (Condition.wait's
+    contract), and the witness held-stack mirrors that."""
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._reg.note_acquire(self._witness_id)
+        return got
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        self._reg.note_release(self._witness_id)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        self._reg.note_acquire(self._witness_id)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+_installed: Optional[_Registry] = None
+
+
+def install(dump_path: str) -> _Registry:
+    """Patch the lock factories; idempotent. The dump lands at exit (or
+    call :func:`dump_now` explicitly — the drills do, so a SIGKILL'd
+    subprocess still banks what it saw up to the last checkpoint)."""
+    global _installed
+    if _installed is not None:
+        return _installed
+    reg = _Registry()
+
+    def make_lock():
+        inner = _ORIG_LOCK()
+        frame = _caller_frame()
+        lid = _lock_id_from_frame(frame) if frame is not None else None
+        if lid is None:
+            return inner
+        reg.register(lid, "Lock")
+        return _LockProxy(inner, lid, reg)
+
+    def make_rlock():
+        inner = _ORIG_RLOCK()
+        frame = _caller_frame()
+        lid = _lock_id_from_frame(frame) if frame is not None else None
+        if lid is None:
+            return inner
+        reg.register(lid, "RLock")
+        return _RLockProxy(inner, lid, reg)
+
+    threading.Lock = make_lock  # type: ignore[assignment]
+    threading.RLock = make_rlock  # type: ignore[assignment]
+    _installed = reg
+    atexit.register(lambda: dump_now(dump_path))
+    return reg
+
+
+def _caller_frame():
+    import sys
+    f = sys._getframe(1)  # make_lock / make_rlock
+    return f.f_back
+
+
+def dump_now(path: str) -> None:
+    if _installed is None:
+        return
+    doc = _installed.dump()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def maybe_install() -> Optional[_Registry]:
+    """Install when ``RAFT_LOCK_WITNESS`` names a dump path — the cli
+    entry point calls this before dispatch, so any serve/train/loadtest
+    leg can witness without code changes."""
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    return install(path)

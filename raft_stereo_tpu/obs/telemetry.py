@@ -181,8 +181,12 @@ class Telemetry:
                 if event != "span":  # span rings live in the tracer
                     self._recent.append(rec)
         except Exception:
-            if not self._emit_failed:
+            # the with-block released the lock during unwinding; re-take it
+            # so the once-only latch is race-free across emitting threads
+            with self._lock:
+                first = not self._emit_failed
                 self._emit_failed = True
+            if first:
                 logger.exception("telemetry emit failed (disabled for run)")
             return
         # Trigger OUTSIDE the lock: flight_dump re-enters emit (for the
@@ -364,9 +368,12 @@ class Telemetry:
         self.heartbeat()
 
     def heartbeat(self) -> None:
-        self._steps += 1
-        self._last_beat = time.monotonic()
-        self._stalled = False
+        # under the bus lock: the watchdog thread reads these as a unit and
+        # flips _stalled back the other way
+        with self._lock:
+            self._steps += 1
+            self._last_beat = time.monotonic()
+            self._stalled = False
 
     def checkpoint(self, step: int, path: str, **payload: Any) -> None:
         """``reason`` rides along as an extra field: "periodic" saves omit
@@ -429,18 +436,24 @@ class Telemetry:
             deadline = self._deadline
             if deadline is None:
                 continue
-            if self._steps == 0:
+            with self._lock:
+                steps = self._steps
+                elapsed = time.monotonic() - self._last_beat
+                fire = elapsed > (deadline * self._grace if steps == 0
+                                  else deadline) and not self._stalled
+                if fire:
+                    self._stalled = True  # one record per episode
+            if steps == 0:
                 deadline = deadline * self._grace
-            elapsed = time.monotonic() - self._last_beat
-            if elapsed > deadline and not self._stalled:
-                self._stalled = True  # one record per episode
+            if fire:
+                # emit/flight_dump OUTSIDE the lock: emit takes it itself
                 logger.warning(
                     "STALL: no step completed in %.1fs (deadline %.1fs) — "
                     "run %s may be wedged (tunneled-TPU stall? see PERF.md); "
                     "details in %s", elapsed, deadline, self.run_name,
                     self.events_path)
                 self.emit("stall", seconds_since_step=round(elapsed, 3),
-                          deadline_s=deadline, steps=self._steps)
+                          deadline_s=deadline, steps=steps)
                 self.flight_dump("stall")
 
 

@@ -206,10 +206,13 @@ def run_clients(server: StereoServer, lt: LoadTestConfig,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t0
-    served = tally["ok"] + tally["failed"]
-    pps = served / wall if wall > 0 else 0.0
+    # the joins above are the happens-before edge, but take the tally lock
+    # anyway: every write to the shared dict stays under the same guard
+    with lock:
+        served = tally["ok"] + tally["failed"]
+        pps = served / wall if wall > 0 else 0.0
+        tally.update(wall_s=round(wall, 3), pairs_per_sec=round(pps, 4),
+                     slo=server.slo.snapshot())
     if telemetry is not None and served:
         telemetry.throughput(pps, steps=served, phase="served")
-    tally.update(wall_s=round(wall, 3), pairs_per_sec=round(pps, 4),
-                 slo=server.slo.snapshot())
     return tally

@@ -227,6 +227,9 @@ class StereoServer:
         self.slo = SLOTracker(telemetry, window=self.serve.slo_window,
                               emit_every=self.serve.slo_every)
         self._queue: BoundedQueue = BoundedQueue(self.serve.queue_depth)
+        # single-owner state: only the scheduler thread mutates these
+        # (graftlint engine 4 baseline names the invariant); other threads
+        # may read len() for gauges but never write
         self._in_flight: "deque" = deque()
         self._sessions: Dict[str, Tuple[Tuple[int, ...], np.ndarray]] = {}
         self._pending_vars: Optional[Dict] = None

@@ -24,12 +24,18 @@ as a subprocess, on CPU, in-sandbox:
   threshold below the sequential baseline — and the serve events must
   carry the v6 ``slo`` rollups (p50/p99, in_flight) plus per-entry
   ``xla_memory`` introspection.
+* **witness** — the same trace under ``RAFT_LOCK_WITNESS``
+  (obs/lockwitness.py): the actual lock-acquisition orders the load
+  exercised are held against graftlint engine 4's static thread
+  topology; a contradiction or a dynamically-closed lock-order cycle
+  fails the leg.
 
 Each leg appends a JSON record to ``runs/load_drill/drills.jsonl``
 through the shared obs/ sink; exit status is non-zero if any leg failed,
 so scripts/rehearse_round.py's ``serve`` leg can gate a round on it.
 
-Run: python scripts/load_drill.py [--drills poison sigterm compare]
+Run: python scripts/load_drill.py [--drills poison sigterm compare
+     witness]
      [--shapes 48x96 64x128 96x64] [--clients 8] [--requests 4]
      [--max-batch 2] [--iters 2] [--keep-work]
 """
@@ -244,12 +250,55 @@ def drill_compare(args, poison_run_dir):
     }
 
 
+def drill_witness(args, work):
+    """Dynamic lock-order witness leg (graftlint engine 4's runtime
+    half): run the load under ``RAFT_LOCK_WITNESS`` so every package
+    lock acquisition is recorded, then hold the witnessed order graph
+    against the static thread topology. A witnessed edge that
+    contradicts the static acquisition order — or that closes a cycle
+    the static pass missed — fails the drill; the evidence banks into
+    drills.jsonl like every other gate."""
+    run_dir = os.path.join(work, "witness")
+    dump = os.path.join(run_dir, "lock_witness.json")
+    os.makedirs(run_dir, exist_ok=True)
+    env = dict(os.environ, RAFT_LOCK_WITNESS=dump)
+    t0 = time.monotonic()
+    proc = subprocess.run(loadtest_cmd(args, run_dir), cwd=REPO, env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=CHILD_TIMEOUT_S)
+    errors = []
+    if proc.returncode != 0:
+        errors.append(f"witnessed loadtest rc={proc.returncode}: "
+                      f"{proc.stdout[-300:]}")
+    findings, locks, edges = [], 0, 0
+    if os.path.exists(dump):
+        from raft_stereo_tpu.analysis.concurrency_rules import (
+            build_topology, check_witness, load_witness)
+        wit = load_witness(dump)
+        locks, edges = len(wit.get("locks", {})), len(wit.get("edges", []))
+        topo = build_topology(os.path.join(REPO, "raft_stereo_tpu"))
+        findings = check_witness(topo, wit)
+        errors.extend(f"{f.rule} {f.location}: {f.message}"
+                      for f in findings if f.severity == "error")
+    else:
+        errors.append("loadtest left no witness dump (the "
+                      "RAFT_LOCK_WITNESS hook did not engage)")
+    wall = time.monotonic() - t0
+    return {
+        "drill": "witness", "ok": not errors, "wall_s": round(wall, 1),
+        "witness_locks": locks, "witnessed_edges": edges,
+        "checks": [f"{f.severity}:{f.location}" for f in findings],
+        "error": "; ".join(errors) or None,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Serving load drill (see module doc)")
     p.add_argument("--drills", nargs="+",
-                   default=["poison", "sigterm", "compare"],
-                   choices=["poison", "sigterm", "compare"])
+                   default=["poison", "sigterm", "compare", "witness"],
+                   choices=["poison", "sigterm", "compare", "witness"])
     p.add_argument("--shapes", nargs="+",
                    default=["48x96", "64x128", "96x64"])
     p.add_argument("--clients", type=int, default=8)
@@ -288,6 +337,8 @@ def main(argv=None):
         else:
             records.append({"drill": "compare", "ok": False,
                             "error": "poison phase left no serve run dir"})
+    if "witness" in args.drills:
+        records.append(drill_witness(args, work))
 
     ok = True
     for rec in records:

@@ -44,18 +44,22 @@ rehearsal:
   ``JAX_PLATFORMS=cpu`` — the jaxpr/compiled-artifact contract rules
   (wgrad placement, dtype policy, donation, host-sync, carry/constant
   size), the SPMD engine (collective placement / sharding-propagation /
-  axis / mesh-donation contracts on the fake 8-device mesh, r10) and the
-  tracer-safety AST lint, gated on unsuppressed error-severity findings
-  against the checked-in ``.graftlint.json`` baseline. A structural
-  regression in the hot path fails the rehearsal even when every numeric
-  test still passes.
+  axis / mesh-donation contracts on the fake 8-device mesh, r10), the
+  tracer-safety AST lint and the concurrency engine (r19: host thread
+  topology, shared-write-unlocked / lock-order / signal-handler /
+  queue-discipline rules over the serve+obs+data threads), gated on
+  unsuppressed error-severity findings against the checked-in
+  ``.graftlint.json`` baseline. A structural regression in the hot path
+  fails the rehearsal even when every numeric test still passes.
 * **fingerprint** — the structural regression gate (r10): ``cli lint
   --fingerprint`` diffs the canonical executables' checked-in fingerprint
   (``.graftlint-fingerprint.json``: conv placement, collective
   kinds/counts in- and out-of-loop, peak bytes, donation pairs) against
   HEAD's lowerings — a new collective, a wgrad conv re-entering the
-  backward loop or a >10% peak-bytes jump fails the leg; intentional
-  structural changes re-bank with ``--update-fingerprint``.
+  backward loop or a >10% peak-bytes jump fails the leg — and (r19) the
+  host thread topology against ``.graftlint-threads.json`` — a new
+  thread entry, a lock dropped from a path or a new shared attribute is
+  gated drift; intentional changes re-bank with ``--update-fingerprint``.
 * **fault** — the fault-tolerance drill (r11): ``python
   scripts/fault_drill.py`` — SIGTERM and SIGKILL kill→auto-resume drills
   must end bitwise-identical to an uninterrupted oracle, the
@@ -68,9 +72,11 @@ rehearsal:
   buckets, 4 concurrent clients incl. one warm-start video stream)
   through the continuous-batching scheduler: the poisoned request must
   fail alone, a mid-load SIGTERM must drain with zero lost admitted
-  requests, and ``cli compare`` must arbitrate served-vs-sequential
-  throughput from the phase's telemetry. The full >=3-bucket/8-client
-  acceptance record is banked separately in runs/load_drill/.
+  requests, ``cli compare`` must arbitrate served-vs-sequential
+  throughput from the phase's telemetry, and the witness leg (r19) must
+  find the load's actual lock-acquisition orders consistent with the
+  static thread topology. The full >=3-bucket/8-client acceptance
+  record is banked separately in runs/load_drill/.
 * **trace** — the tracing rehearsal (r13): ``python
   scripts/trace_drill.py`` — a tiny CPU train and a tiny loadtest must
   each yield ``cli timeline`` exit 0 with >= 90% of every step's/
@@ -333,7 +339,10 @@ def main(argv=None):
             "serve",
             [sys.executable, os.path.join(REPO, "scripts", "load_drill.py"),
              "--small", "--shapes", "48x96", "64x128",
-             "--clients", "4", "--requests", "3"],
+             "--clients", "4", "--requests", "3",
+             # witness: the drilled interleavings' actual lock-acquisition
+             # orders are held against engine 4's static thread topology
+             "--drills", "poison", "sigterm", "compare", "witness"],
             args.serve_budget, env={"JAX_PLATFORMS": "cpu"}))
     if "trace" in args.legs:
         records.append(run_leg(

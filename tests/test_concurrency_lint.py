@@ -1,0 +1,588 @@
+"""graftlint engine 4 (analysis/concurrency_rules.py): every concurrency
+rule fires on a minimal seeded fixture AND stays silent on the clean
+pair, the thread-topology fingerprint gates doctored drift, the dynamic
+lock-order witness contradicts/confirms the static order, and HEAD —
+after this round's triage — passes ``cli lint --concurrency`` with zero
+unsuppressed error-severity findings.
+
+Fixtures are tiny synthetic packages written to tmp_path so each rule's
+trigger condition is explicit; the model-scale path is the HEAD test,
+which walks the real serve/obs/data/training thread topology.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from raft_stereo_tpu.analysis.concurrency_rules import (CONCURRENCY_RULES,
+                                                        RULE_VERSIONS,
+                                                        build_topology,
+                                                        check_witness,
+                                                        diff_topology,
+                                                        load_topology,
+                                                        run_concurrency_rules,
+                                                        write_topology)
+from raft_stereo_tpu.analysis.runner import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pkg(tmp_path, source, name="fixpkg"):
+    pkg = tmp_path / name
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return str(pkg)
+
+
+def _rules(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def _empty_baseline(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "suppressions": []}))
+    return str(path)
+
+
+# --------------------------------------------------- shared-write-unlocked
+
+DIRTY_SHARED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def bump(self):
+            self.count += 1
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+CLEAN_SHARED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                with self._lock:
+                    self.count += 1
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+
+def test_shared_write_unlocked_fires(tmp_path):
+    fs = _rules(run_concurrency_rules(_pkg(tmp_path, DIRTY_SHARED)),
+                "shared-write-unlocked")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert fs[0].location.endswith("::Worker.count")
+    # both writing entries are named in the message
+    assert "_run[thread]" in fs[0].message
+    assert "[callers]" in fs[0].message
+
+
+def test_shared_write_locked_is_clean(tmp_path):
+    fs = run_concurrency_rules(_pkg(tmp_path, CLEAN_SHARED))
+    assert not [f for f in fs if f.severity == "error"], \
+        [f"{f.rule}@{f.location}" for f in fs]
+
+
+# ------------------------------------------------------- lock-order-cycle
+
+DIRTY_ORDER = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self._fwd, daemon=True)
+
+        def _fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def back(self):
+            with self._b:
+                with self._a:
+                    pass
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+CLEAN_ORDER = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._t = threading.Thread(target=self._fwd, daemon=True)
+
+        def _fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def back(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+
+def test_lock_order_cycle_fires(tmp_path):
+    fs = _rules(run_concurrency_rules(_pkg(tmp_path, DIRTY_ORDER)),
+                "lock-order-cycle")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "AB._a" in fs[0].message and "AB._b" in fs[0].message
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    fs = run_concurrency_rules(_pkg(tmp_path, CLEAN_ORDER))
+    assert not _rules(fs, "lock-order-cycle")
+    assert not [f for f in fs if f.severity == "error"]
+
+
+# -------------------------------------------------- cond-wait-no-predicate
+
+DIRTY_COND = """
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self.ready = False
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            with self._cv:
+                self._cv.wait()
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+CLEAN_COND = """
+    import threading
+
+    class Waiter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self.ready = False
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            with self._cv:
+                while not self.ready:
+                    self._cv.wait()
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+
+def test_cond_wait_without_while_fires(tmp_path):
+    fs = _rules(run_concurrency_rules(_pkg(tmp_path, DIRTY_COND)),
+                "cond-wait-no-predicate")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "Waiter._loop" in fs[0].location
+
+
+def test_cond_wait_in_while_is_clean(tmp_path):
+    fs = run_concurrency_rules(_pkg(tmp_path, CLEAN_COND))
+    assert not _rules(fs, "cond-wait-no-predicate")
+    assert not [f for f in fs if f.severity == "error"]
+
+
+# --------------------------------------------------- signal-handler-unsafe
+
+DIRTY_SIGNAL = """
+    import signal
+    import threading
+
+    class Guard:
+        def __init__(self):
+            self._lock = threading.Lock()
+            signal.signal(signal.SIGTERM, self._handle)
+
+        def _handle(self, signum, frame):
+            with self._lock:
+                print("terminating")
+"""
+
+CLEAN_SIGNAL = """
+    import signal
+
+    class Guard:
+        def __init__(self):
+            self.requested = False
+            signal.signal(signal.SIGTERM, self._handle)
+
+        def _handle(self, signum, frame):
+            self.requested = True
+"""
+
+
+def test_emitting_signal_handler_fires(tmp_path):
+    fs = _rules(run_concurrency_rules(_pkg(tmp_path, DIRTY_SIGNAL)),
+                "signal-handler-unsafe")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "acquire" in fs[0].message and "print" in fs[0].message
+
+
+def test_flag_only_signal_handler_is_clean(tmp_path):
+    fs = run_concurrency_rules(_pkg(tmp_path, CLEAN_SIGNAL))
+    assert not [f for f in fs if f.severity == "error"], \
+        [f"{f.rule}@{f.location}" for f in fs]
+
+
+# ---------------------------------------------------------- daemon-no-join
+
+DIRTY_DAEMON = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            pass
+"""
+
+CLEAN_DAEMON = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            pass
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+
+def test_joinless_daemon_fires(tmp_path):
+    fs = _rules(run_concurrency_rules(_pkg(tmp_path, DIRTY_DAEMON)),
+                "daemon-no-join")
+    assert len(fs) == 1 and fs[0].severity == "error"
+
+
+def test_joined_daemon_is_clean(tmp_path):
+    fs = run_concurrency_rules(_pkg(tmp_path, CLEAN_DAEMON))
+    assert not [f for f in fs if f.severity == "error"]
+
+
+# ------------------------------------------------- queue-timeout-discipline
+
+DIRTY_QUEUE = """
+    import queue
+    import threading
+
+    class Feeder:
+        def __init__(self):
+            self._q = queue.Queue()
+            self._t = threading.Thread(target=self._producer, daemon=True)
+
+        def consume(self):
+            while True:
+                item = self._q.get()
+                if item is None:
+                    break
+
+        def _producer(self):
+            self._q.put(1)
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+CLEAN_QUEUE = """
+    import queue
+    import threading
+
+    class Feeder:
+        def __init__(self):
+            self._q = queue.Queue()
+            self._t = threading.Thread(target=self._producer, daemon=True)
+
+        def consume(self):
+            while True:
+                item = self._q.get(timeout=5.0)
+                if item is None:
+                    break
+
+        def _producer(self):
+            self._q.put(1)
+
+        def stop(self):
+            self._t.join(timeout=1.0)
+"""
+
+
+def test_blocking_get_without_timeout_fires(tmp_path):
+    fs = _rules(run_concurrency_rules(_pkg(tmp_path, DIRTY_QUEUE)),
+                "queue-timeout-discipline")
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "Feeder.consume" in fs[0].location
+
+
+def test_get_with_timeout_is_clean(tmp_path):
+    fs = run_concurrency_rules(_pkg(tmp_path, CLEAN_QUEUE))
+    assert not _rules(fs, "queue-timeout-discipline")
+    assert not [f for f in fs if f.severity == "error"]
+
+
+# ------------------------------------------------ cli exit codes (gate)
+
+@pytest.mark.parametrize("source", [DIRTY_SHARED, DIRTY_ORDER, DIRTY_COND,
+                                    DIRTY_SIGNAL, DIRTY_DAEMON,
+                                    DIRTY_QUEUE])
+def test_cli_lint_concurrency_exits_1_on_violation(tmp_path, source):
+    rc = lint_main(["--concurrency", "--package-root",
+                    _pkg(tmp_path, source),
+                    "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 1
+
+
+def test_cli_lint_concurrency_exits_0_on_clean_fixture(tmp_path):
+    rc = lint_main(["--concurrency", "--package-root",
+                    _pkg(tmp_path, CLEAN_SHARED),
+                    "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+
+
+def test_head_passes_concurrency_lint():
+    """The real package, after this round's triage (telemetry heartbeat/
+    watchdog under the bus lock, loadtest tally under its lock, loader
+    get-with-timeout, the named single-owner/vetted-handler baseline
+    entries), carries zero unsuppressed concurrency errors."""
+    rc = lint_main(["--concurrency"])
+    assert rc == 0
+
+
+# ------------------------------------------------ thread-topology drift
+
+def test_topology_roundtrip_and_doctored_drift(tmp_path):
+    pkg = _pkg(tmp_path, CLEAN_SHARED)
+    topo = build_topology(pkg)
+    path = tmp_path / "threads.json"
+    write_topology(str(path), topo)
+    assert diff_topology(load_topology(str(path)), topo) == []
+
+    # doctored: the current tree grew a thread entry the baseline never
+    # reviewed -> error drift
+    baseline = json.loads(path.read_text())
+    eid = next(iter(baseline["entries"]))
+    removed = baseline["entries"].pop(eid)
+    fs = diff_topology(baseline, topo)
+    assert any(f.severity == "error" and "new thread entry" in f.message
+               for f in fs)
+
+    # doctored: a lock dropped from a previously-guarded path -> error
+    baseline["entries"][eid] = removed
+    locked = next(e for e in baseline["entries"].values() if e["locks"])
+    doctored = dict(topo)
+    doctored["entries"] = {
+        k: (dict(v, locks=[]) if v["locks"] else v)
+        for k, v in topo["entries"].items()}
+    fs = diff_topology(baseline, doctored)
+    assert any(f.severity == "error" and "dropped" in f.message
+               for f in fs), locked
+
+
+def test_cli_fingerprint_gates_doctored_topology(tmp_path):
+    """`cli lint --fingerprint` fails when the checked-in topology no
+    longer matches the tree (the acceptance criterion's doctored-map
+    case), and passes against the map it just banked."""
+    pkg = _pkg(tmp_path, CLEAN_SHARED)
+    fp = str(tmp_path / "fp.json")
+    tb = str(tmp_path / "threads.json")
+    common = ["--concurrency", "--package-root", pkg, "--no-compile",
+              "--fingerprint", "--fingerprint-baseline", fp,
+              "--threads-baseline", tb,
+              "--baseline", _empty_baseline(tmp_path)]
+    assert lint_main(common + ["--update-fingerprint"]) == 0
+    assert lint_main(common) == 0
+
+    doc = json.loads(open(tb).read())
+    # a thread entry disappears from the baseline -> the current tree has
+    # an unreviewed "new" entry -> gated
+    doc["entries"].pop(next(iter(doc["entries"])))
+    with open(tb, "w") as f:
+        json.dump(doc, f)
+    assert lint_main(common) == 1
+
+
+def test_head_topology_baseline_is_current():
+    """.graftlint-threads.json is checked in and matches HEAD."""
+    path = os.path.join(REPO, ".graftlint-threads.json")
+    assert os.path.exists(path), \
+        "regenerate with: cli lint --fingerprint --update-fingerprint"
+    baseline = load_topology(path)
+    current = build_topology(os.path.join(REPO, "raft_stereo_tpu"))
+    drift = [f for f in diff_topology(baseline, current)
+             if f.severity == "error"]
+    assert drift == [], [f"{f.location}: {f.message}" for f in drift]
+
+
+# ------------------------------------------------- the lock-order witness
+
+def test_witness_contradiction_is_error(tmp_path):
+    """A hand-built acquisition log that reverses the static order fails
+    the witness check."""
+    pkg = _pkg(tmp_path, CLEAN_ORDER)  # static order: _a -> _b
+    topo = build_topology(pkg)
+    assert topo["lock_order"], "fixture should have a static order edge"
+    a, b = topo["lock_order"][0]
+    fs = check_witness(topo, {"version": 1, "locks": {}, "edges": [[b, a, 3]]})
+    errors = [f for f in fs if f.severity == "error"]
+    # the reversed edge both contradicts the static order AND closes the
+    # 2-cycle with it — two findings, one deadlock window
+    assert errors and any("contradicts" in f.message for f in errors)
+
+
+def test_witness_closing_unseen_cycle_is_error(tmp_path):
+    pkg = _pkg(tmp_path, CLEAN_ORDER)
+    topo = build_topology(pkg)
+    a, b = topo["lock_order"][0]
+    # dynamics route b back to a through a third lock the static pass
+    # never ordered: the union closes a cycle -> error
+    wit = {"version": 1, "locks": {}, "edges": [[b, "x::C.l", 1],
+                                               ["x::C.l", a, 1]]}
+    fs = check_witness(topo, wit)
+    assert any(f.severity == "error" and "cycle" in f.message for f in fs)
+
+
+def test_consistent_witness_is_green(tmp_path):
+    pkg = _pkg(tmp_path, CLEAN_ORDER)
+    topo = build_topology(pkg)
+    a, b = topo["lock_order"][0]
+    fs = check_witness(topo, {"version": 1,
+                              "locks": {a: "Lock", b: "Lock"},
+                              "edges": [[a, b, 7]]})
+    assert not [f for f in fs if f.severity == "error"]
+    assert any("consistent" in f.message for f in fs)
+
+
+def test_cli_witness_flag_gates(tmp_path):
+    pkg = _pkg(tmp_path, CLEAN_ORDER)
+    topo = build_topology(pkg)
+    a, b = topo["lock_order"][0]
+    wpath = tmp_path / "witness.json"
+    wpath.write_text(json.dumps(
+        {"version": 1, "locks": {}, "edges": [[b, a, 1]]}))
+    args = ["--concurrency", "--package-root", pkg,
+            "--witness", str(wpath),
+            "--baseline", _empty_baseline(tmp_path)]
+    assert lint_main(args) == 1
+    wpath.write_text(json.dumps(
+        {"version": 1, "locks": {}, "edges": [[a, b, 1]]}))
+    assert lint_main(args) == 0
+
+
+def test_witness_records_real_acquisitions(tmp_path):
+    """obs/lockwitness.py end to end in-process: package-created locks
+    are wrapped, nesting records an order edge with the canonical ids."""
+    import threading
+
+    from raft_stereo_tpu.obs import lockwitness
+
+    reg = lockwitness._Registry()
+    # simulate what install() does for two package locks
+    outer = lockwitness._LockProxy(threading.Lock(), "m.py::A._outer", reg)
+    inner = lockwitness._LockProxy(threading.Lock(), "m.py::A._inner", reg)
+    reg.register("m.py::A._outer", "Lock")
+    reg.register("m.py::A._inner", "Lock")
+    with outer:
+        with inner:
+            pass
+    with outer:
+        pass
+    doc = reg.dump()
+    assert doc["edges"] == [["m.py::A._outer", "m.py::A._inner", 1]]
+    assert set(doc["locks"]) == {"m.py::A._outer", "m.py::A._inner"}
+
+
+# ----------------------------------------------------- engine metadata
+
+def test_rule_surface_registered():
+    """Every engine-4 rule is versioned and reported to the runner."""
+    assert set(CONCURRENCY_RULES) == set(RULE_VERSIONS)
+    assert {"shared-write-unlocked", "lock-order-cycle",
+            "cond-wait-no-predicate", "signal-handler-unsafe",
+            "daemon-no-join", "queue-timeout-discipline",
+            "thread-topology-drift",
+            "lock-order-witness"} == set(RULE_VERSIONS)
+    from raft_stereo_tpu.analysis.runner import rule_versions
+    merged = rule_versions()
+    for rule, v in RULE_VERSIONS.items():
+        assert merged[rule] == v
+
+
+def test_cli_drift_v10_fires_on_seeded_drill_fixture(tmp_path):
+    """cli-drift v10: the drill/runner scripts are self-consumed surfaces
+    — a parsed-then-dropped flag fires, and an aliased dest= no longer
+    false-fires."""
+    from raft_stereo_tpu.analysis.ast_rules import (RULE_VERSIONS as ast_v,
+                                                    check_entry_surface_drift)
+    assert ast_v["cli-drift"] == 10
+    sdir = tmp_path / "scripts"
+    sdir.mkdir()
+    (sdir / "load_drill.py").write_text(textwrap.dedent("""
+        import argparse
+
+        def main():
+            p = argparse.ArgumentParser()
+            p.add_argument("--shapes", nargs="+")
+            p.add_argument("--orphan-flag", action="store_true")
+            p.add_argument("--json", dest="json_out")
+            args = p.parse_args()
+            print(args.shapes, args.json_out)
+    """))
+    fs = [f for f in check_entry_surface_drift(str(tmp_path))
+          if f.rule == "cli-drift"]
+    assert [f.data["dest"] for f in fs] == ["orphan_flag"]
+
+
+def test_real_lint_surfaces_are_self_consumed():
+    """The runner's own argparse surface (--concurrency, --witness,
+    --threads-baseline) and the drill scripts read every flag they
+    declare on the real tree."""
+    from raft_stereo_tpu.analysis.ast_rules import check_entry_surface_drift
+    fs = [f for f in check_entry_surface_drift(REPO)
+          if f.rule == "cli-drift"]
+    assert fs == [], [f"{f.location}: {f.message}" for f in fs]

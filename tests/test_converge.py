@@ -624,7 +624,7 @@ def test_cli_drift_v7_fires_on_seeded_converge_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 9
+    assert RULE_VERSIONS["cli-drift"] == 10
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "obs").mkdir(parents=True)
     (pkg / "cli.py").write_text(

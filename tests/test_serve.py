@@ -526,7 +526,7 @@ def test_cli_drift_v3_fires_on_seeded_serve_fixture(tmp_path):
     from raft_stereo_tpu.analysis.ast_rules import (
         RULE_VERSIONS, check_entry_surface_drift)
 
-    assert RULE_VERSIONS["cli-drift"] == 9
+    assert RULE_VERSIONS["cli-drift"] == 10
     pkg = tmp_path / "raft_stereo_tpu"
     (pkg / "serve").mkdir(parents=True)
     (pkg / "cli.py").write_text(
